@@ -1,0 +1,17 @@
+"""mapreduce_tpu: a TPU-native MapReduce framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the reference CUDA
+MapReduce word counter (``zimisoho/cuda-mapreduce``, see SURVEY.md): device-side
+tokenization via segmented associative scans, sort/segment-sum parallel
+reduction into mergeable count tables, collective global aggregation over a
+``jax.sharding.Mesh``, a streaming sharded ingest pipeline, and a generic
+map/combine/merge MapReduce engine — replacing, respectively, the reference's
+host tokenizer (``main.cu:187-202``), per-thread map kernel (``main.cu:109``),
+single-thread serial reduce (``main.cu:119-123``), ``cudaMemcpy`` transport
+(``main.cu:143-161``), and ``runMapReduce`` orchestrator (``main.cu:133``).
+"""
+
+from mapreduce_tpu.config import Config, DEFAULT_CONFIG, SMALL_CONFIG
+from mapreduce_tpu.version import __version__
+
+__all__ = ["Config", "DEFAULT_CONFIG", "SMALL_CONFIG", "__version__"]

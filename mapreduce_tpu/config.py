@@ -1,0 +1,55 @@
+"""Runtime configuration.
+
+The reference has no flag system: ``argv`` is ignored (``main.cu:164``) and
+every capacity is a compile-time ``#define`` (``main.cu:9-15``).  Here all
+sizing is a runtime dataclass; shapes are static *per compiled step* (an XLA
+requirement) but chosen freely per run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Sizing and execution knobs for a MapReduce run.
+
+    Attributes:
+      chunk_bytes: bytes per device step per device.  The unit of streaming;
+        each jitted step consumes this many bytes on every device.  Must be a
+        multiple of 128 for TPU lane alignment.
+      table_capacity: distinct keys the running count table can hold (per
+        final table).  Beyond this, rarest-by-arrival keys spill and are
+        tallied in ``dropped_*`` diagnostics rather than silently corrupting
+        memory like the reference does past MAX_OUTPUT_COUNT (main.cu:103-104).
+      batch_unique_capacity: distinct keys extracted from one step's chunk
+        before merging into the table.  Bounded by tokens-per-chunk; a chunk of
+        N bytes has at most ceil(N/2) tokens.
+      mesh_axis: name of the data-parallel mesh axis.
+    """
+
+    chunk_bytes: int = 1 << 20
+    table_capacity: int = 1 << 18
+    batch_unique_capacity: Optional[int] = None
+    mesh_axis: str = "data"
+
+    def __post_init__(self) -> None:
+        if self.chunk_bytes % 128 != 0:
+            raise ValueError(f"chunk_bytes must be a multiple of 128, got {self.chunk_bytes}")
+        if self.table_capacity < 2:
+            raise ValueError("table_capacity must be >= 2")
+
+    @property
+    def batch_uniques(self) -> int:
+        if self.batch_unique_capacity is not None:
+            return self.batch_unique_capacity
+        # At most one token per two bytes, +1 slack for the sentinel segment.
+        return min(self.chunk_bytes // 2 + 1, self.table_capacity)
+
+
+DEFAULT_CONFIG = Config()
+
+# A small config for tests / the bundled-fixture CLI path.
+SMALL_CONFIG = Config(chunk_bytes=1 << 10, table_capacity=1 << 10)

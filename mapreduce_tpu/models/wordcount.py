@@ -1,0 +1,76 @@
+"""WordCount: the flagship model of the framework.
+
+End-to-end equivalent of the reference program (tokenize ``main.cu:187-202``
+-> map ``main.cu:37-54`` -> reduce ``main.cu:69-108`` -> report
+``main.cu:212-218``), rebuilt TPU-first: bytes go to the device as a padded
+uint8 tensor, tokenization/hashing/counting happen in one jitted XLA program,
+and only the small count table returns to the host, where exact strings are
+recovered from first-occurrence positions.
+
+This module is the simple single-buffer path used by the CLI and tests; the
+streaming / multi-chip path lives in :mod:`mapreduce_tpu.runtime.executor` and
+:mod:`mapreduce_tpu.parallel.mapreduce`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import numpy as np
+
+from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.ops import table as table_ops
+from mapreduce_tpu.ops import tokenize as tok_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class WordCountResult:
+    """Host-side result with recovered strings, insertion-ordered."""
+
+    words: list[bytes]  # distinct words, by first occurrence
+    counts: list[int]  # parallel to words
+    total: int  # total tokens (includes any spilled ones)
+    dropped_uniques: int  # diagnostic: distinct words spilled past capacity
+    dropped_count: int  # tokens belonging to spilled words
+
+    def as_dict(self) -> dict[bytes, int]:
+        return dict(zip(self.words, self.counts))
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def _count_step(data: jax.Array, capacity: int) -> table_ops.CountTable:
+    stream = tok_ops.tokenize(data)
+    return table_ops.from_stream(stream, capacity)
+
+
+def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> table_ops.CountTable:
+    """Run the device pipeline over one in-memory buffer, return the table."""
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    padded_len = max(128, -(-buf.shape[0] // 128) * 128)
+    padded = tok_ops.pad_to(buf, padded_len)
+    return _count_step(jax.device_put(padded), config.table_capacity)
+
+
+def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
+    """Host-side string recovery from a single-buffer table (pos_hi == 0)."""
+    count = np.asarray(tbl.count)
+    valid = count > 0
+    pos = np.asarray(tbl.pos_lo)[valid]
+    length = np.asarray(tbl.length)[valid]
+    cnt = count[valid]
+    order = np.argsort(pos, kind="stable")
+    words = [bytes(source[int(p): int(p) + int(l)]) for p, l in zip(pos[order], length[order])]
+    return WordCountResult(
+        words=words,
+        counts=[int(c) for c in cnt[order]],
+        total=int(np.asarray(tbl.total_count())),
+        dropped_uniques=int(np.asarray(tbl.dropped_uniques)),
+        dropped_count=int(np.asarray(tbl.dropped_count)),
+    )
+
+
+def count_words(data: bytes, config: Config = DEFAULT_CONFIG) -> WordCountResult:
+    """The one-call API: exact word counts for an in-memory buffer."""
+    return recover_result(count_table(data, config), data)

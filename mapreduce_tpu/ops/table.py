@@ -1,0 +1,162 @@
+"""Sorted fixed-capacity count tables: the parallel reduce data plane.
+
+The reference reduces with a single device thread doing an O(pairs x distinct)
+linear-scan group-by (``reducer``, ``main.cu:69-108``, launched serially via
+the ``i < 1`` loop at ``main.cu:120``).  Here the same group-by-key-and-sum is
+a *sort + segment-reduce*: O(n log n) work, fully parallel, static shapes, and
+— crucially — the resulting :class:`CountTable` has an **associative merge**,
+which is what lets the global reduction become a collective (tree ``ppermute``
+/ ``all_gather`` / key-range ``all_to_all``) instead of the reference's serial
+device-wide pass.
+
+Invariants of a well-formed table (established by every constructor here):
+  * entries are sorted ascending by 64-bit key;
+  * occupied slots (count > 0) form a prefix; empty slots carry the sentinel
+    key, count 0, pos = +inf, length 0;
+  * ``(pos_hi, pos_lo)`` is the lexicographically smallest (i.e. first)
+    occurrence of the key, enabling exact insertion-order reporting and
+    host-side string recovery (SURVEY §7);
+  * overflow past capacity is *accounted* (``dropped_count`` exact,
+    ``dropped_uniques`` an upper bound), never silent corruption like the
+    reference past MAX_OUTPUT_COUNT (``main.cu:103-104``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mapreduce_tpu import constants
+from mapreduce_tpu.ops.tokenize import TokenStream
+
+
+class CountTable(NamedTuple):
+    """Keyed count state.  A pytree; all fields are device arrays."""
+
+    key_hi: jax.Array  # uint32[V], sorted (with key_lo) ascending
+    key_lo: jax.Array  # uint32[V]
+    count: jax.Array  # uint32[V]
+    pos_hi: jax.Array  # uint32[V]  (device,step) buffer id of first occurrence
+    pos_lo: jax.Array  # uint32[V]  byte offset within that buffer
+    length: jax.Array  # uint32[V]  token length in bytes
+    dropped_uniques: jax.Array  # uint32 scalar, >= true number of spilled keys
+    dropped_count: jax.Array  # uint32 scalar, exact token count spilled
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+    def n_valid(self) -> jax.Array:
+        return jnp.sum((self.count > 0).astype(jnp.uint32))
+
+    def total_count(self) -> jax.Array:
+        """Total tokens represented, including spilled ones."""
+        return jnp.sum(self.count) + self.dropped_count
+
+
+def empty(capacity: int) -> CountTable:
+    sent = jnp.full((capacity,), constants.SENTINEL_KEY, dtype=jnp.uint32)
+    zero = jnp.zeros((capacity,), dtype=jnp.uint32)
+    inf = jnp.full((capacity,), constants.POS_INF, dtype=jnp.uint32)
+    s0 = jnp.uint32(0)
+    return CountTable(sent, jnp.array(sent), zero, inf, jnp.array(inf), jnp.array(zero), s0, jnp.uint32(0))
+
+
+def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int):
+    """Group-by-key segment reduce of rows already sorted by (key, pos)."""
+    n = key_hi.shape[0]
+    prev_hi = jnp.concatenate([key_hi[:1], key_hi[:-1]])
+    prev_lo = jnp.concatenate([key_lo[:1], key_lo[:-1]])
+    boundary = (key_hi != prev_hi) | (key_lo != prev_lo)
+    boundary = boundary.at[0].set(True)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # int32[n]
+
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    inf = jnp.uint32(constants.POS_INF)
+
+    count_u = jnp.zeros((capacity,), jnp.uint32).at[seg].add(count, mode="drop")
+    # Only the first (boundary) row of each segment contributes, so min/max
+    # against masked fill just selects that row's value.
+    key_hi_u = jnp.full((capacity,), sent).at[seg].min(jnp.where(boundary, key_hi, sent), mode="drop")
+    key_lo_u = jnp.full((capacity,), sent).at[seg].min(jnp.where(boundary, key_lo, sent), mode="drop")
+    pos_hi_u = jnp.full((capacity,), inf).at[seg].min(jnp.where(boundary, pos_hi, inf), mode="drop")
+    pos_lo_u = jnp.full((capacity,), inf).at[seg].min(jnp.where(boundary, pos_lo, inf), mode="drop")
+    len_u = jnp.zeros((capacity,), jnp.uint32).at[seg].max(jnp.where(boundary, length, jnp.uint32(0)), mode="drop")
+
+    occupied = count_u > 0
+    key_hi_u = jnp.where(occupied, key_hi_u, sent)
+    key_lo_u = jnp.where(occupied, key_lo_u, sent)
+    pos_hi_u = jnp.where(occupied, pos_hi_u, inf)
+    pos_lo_u = jnp.where(occupied, pos_lo_u, inf)
+    len_u = jnp.where(occupied, len_u, jnp.uint32(0))
+
+    # Overflow accounting.  The sentinel rows (empty slots / non-token
+    # positions) always form the final segment when present.
+    has_sentinel = (key_hi[-1] == sent) & (key_lo[-1] == sent)
+    n_segments = (seg[-1] + 1).astype(jnp.uint32)
+    n_real = n_segments - has_sentinel.astype(jnp.uint32)
+    cap = jnp.uint32(capacity)
+    dropped_uniques = jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
+    dropped_count = jnp.sum(count) - jnp.sum(count_u)
+    return (key_hi_u, key_lo_u, count_u, pos_hi_u, pos_lo_u, len_u, dropped_uniques, dropped_count)
+
+
+def _build(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int,
+           carry_du, carry_dc) -> CountTable:
+    """Sort rows by (key, first-occurrence) and segment-reduce into a table."""
+    key_hi, key_lo, pos_hi, pos_lo, count, length = jax.lax.sort(
+        (key_hi, key_lo, pos_hi, pos_lo, count, length), num_keys=4
+    )
+    (key_hi_u, key_lo_u, count_u, pos_hi_u, pos_lo_u, len_u, du, dc) = _reduce_sorted_rows(
+        key_hi, key_lo, pos_hi, pos_lo, count, length, capacity
+    )
+    return CountTable(
+        key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,
+        pos_hi=pos_hi_u, pos_lo=pos_lo_u, length=len_u,
+        dropped_uniques=carry_du + du, dropped_count=carry_dc + dc,
+    )
+
+
+def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0) -> CountTable:
+    """Aggregate a per-byte :class:`TokenStream` into a fresh table.
+
+    ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
+    device_index``) so first-occurrence order is globally meaningful.
+    """
+    n = stream.key_hi.shape[0]
+    ph = jnp.full((n,), jnp.asarray(pos_hi, dtype=jnp.uint32))
+    ph = jnp.where(stream.count > 0, ph, jnp.uint32(constants.POS_INF))
+    return _build(stream.key_hi, stream.key_lo, ph, stream.pos, stream.count,
+                  stream.length, capacity, jnp.uint32(0), jnp.uint32(0))
+
+
+def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTable:
+    """Associative, commutative merge of two tables (the combiner)."""
+    cap = capacity if capacity is not None else max(a.capacity, b.capacity)
+    cat = lambda f, g: jnp.concatenate([f, g])
+    return _build(
+        cat(a.key_hi, b.key_hi), cat(a.key_lo, b.key_lo),
+        cat(a.pos_hi, b.pos_hi), cat(a.pos_lo, b.pos_lo),
+        cat(a.count, b.count), cat(a.length, b.length),
+        cap, a.dropped_uniques + b.dropped_uniques, a.dropped_count + b.dropped_count,
+    )
+
+
+def update(table: CountTable, stream: TokenStream, batch_capacity: int,
+           pos_hi: jax.Array | int = 0) -> CountTable:
+    """Fold one chunk's tokens into the running table (one streaming step)."""
+    batch = from_stream(stream, batch_capacity, pos_hi=pos_hi)
+    return merge(table, batch, capacity=table.capacity)
+
+
+def top_k(table: CountTable, k: int) -> CountTable:
+    """The k most frequent keys, as a (count-descending) table of capacity k."""
+    order = jnp.argsort(jnp.uint32(0xFFFFFFFF) - table.count)[:k]
+    take = lambda f: f[order]
+    return CountTable(
+        key_hi=take(table.key_hi), key_lo=take(table.key_lo), count=take(table.count),
+        pos_hi=take(table.pos_hi), pos_lo=take(table.pos_lo), length=take(table.length),
+        dropped_uniques=table.dropped_uniques, dropped_count=table.dropped_count,
+    )

@@ -1,0 +1,168 @@
+"""Device-side tokenization + hashing.
+
+Replaces both the reference's host tokenizer (char-scan loop, ``main.cu:187-202``)
+and its device map UDF (per-thread byte-copy loops, ``mapper`` ``main.cu:37-54``)
+with one data-parallel pass: a *segmented associative scan* over the raw byte
+tensor.
+
+Formulation
+-----------
+Scanning a token's bytes left-to-right with ``h' = h * B + c`` is composition
+of affine maps ``f_c(h) = h*B + c``; affine composition is associative, so the
+whole pass runs as ``jax.lax.associative_scan`` (log-depth, VPU-friendly,
+static shapes) instead of a serial per-char loop.  Separator bytes insert a
+*reset* element, giving the segmented variant: after the scan, every position
+holds the rolling hash of the token prefix ending there, and positions where a
+non-separator byte is followed by a separator (or end-of-buffer) hold the hash
+of a complete token.
+
+Two independent 32-bit lanes (different odd bases) form an effective 64-bit
+key, finalized with murmur3's fmix32.  This fixes the reference's prefix-match
+comparator defect (``compare``, ``main.cu:57-67``) by construction: equality is
+on full-token 64-bit hashes (token length is mixed in as well).
+
+No token strings are materialized on device.  For reporting, each table entry
+carries the position/length of its first occurrence so the host can recover
+the exact bytes from the source (SURVEY §7 "String recovery").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_tpu import constants
+
+
+class TokenStream(NamedTuple):
+    """Per-byte-position token emissions (shape = input byte count).
+
+    Positions that do not end a token carry the sentinel key and count 0; they
+    are compacted away by :func:`mapreduce_tpu.ops.segment.unique_count`.
+    """
+
+    key_hi: jax.Array  # uint32
+    key_lo: jax.Array  # uint32
+    count: jax.Array  # uint32: 1 at token ends, else 0
+    pos: jax.Array  # uint32: byte offset of the token's *first* byte
+    length: jax.Array  # uint32: token length in bytes
+
+
+def separator_mask(data: jax.Array) -> jax.Array:
+    """True where the byte is a separator (whitespace / NUL pad)."""
+    sep = jnp.zeros(data.shape, dtype=jnp.bool_)
+    for b in constants.SEPARATOR_BYTES:
+        sep = sep | (data == jnp.uint8(b))
+    return sep
+
+
+def _fmix32(x: jax.Array) -> jax.Array:
+    """murmur3 finalizer: bijective avalanche on a uint32 lane."""
+    x = x ^ (x >> 16)
+    x = x * constants.FMIX_C1
+    x = x ^ (x >> 13)
+    x = x * constants.FMIX_C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def _segmented_combine(a, b):
+    """Associative combine for the segmented affine-map scan.
+
+    Element = (reset, v1, p1, v2, p2, length).  ``(v, p)`` represents the
+    affine map ``h -> h*p + v`` accumulated since the last reset; ``reset``
+    marks that the right operand contains a segment boundary, which discards
+    the left operand's contribution.
+    """
+    a_f, a_v1, a_p1, a_v2, a_p2, a_len = a
+    b_f, b_v1, b_p1, b_v2, b_p2, b_len = b
+    f = a_f | b_f
+    v1 = jnp.where(b_f, b_v1, a_v1 * b_p1 + b_v1)
+    p1 = jnp.where(b_f, b_p1, a_p1 * b_p1)
+    v2 = jnp.where(b_f, b_v2, a_v2 * b_p2 + b_v2)
+    p2 = jnp.where(b_f, b_p2, a_p2 * b_p2)
+    ln = jnp.where(b_f, b_len, a_len + b_len)
+    return (f, v1, p1, v2, p2, ln)
+
+
+def tokenize(data: jax.Array, base_offset: jax.Array | int = 0) -> TokenStream:
+    """Hash every whitespace-delimited token in a flat uint8 buffer.
+
+    Args:
+      data: uint8[N] byte buffer.  N is static.  The buffer is treated as if
+        followed by a separator, so a token touching the end is complete —
+        ingest must therefore only split shards at separator boundaries.
+      base_offset: uint32 added to emitted positions (for global addressing of
+        a shard within a larger stream).
+
+    Returns:
+      A :class:`TokenStream` of length N.
+    """
+    if data.dtype != jnp.uint8:
+        raise TypeError(f"tokenize expects uint8 bytes, got {data.dtype}")
+    if data.ndim != 1:
+        raise ValueError(f"tokenize expects a flat buffer, got shape {data.shape}")
+
+    n = data.shape[0]
+    sep = separator_mask(data)
+    c = data.astype(jnp.uint32)
+
+    one = jnp.uint32(1)
+    zero = jnp.uint32(0)
+    elems = (
+        sep,
+        jnp.where(sep, zero, c + one),
+        jnp.where(sep, one, jnp.uint32(constants.HASH_BASE_1)),
+        jnp.where(sep, zero, c + one),
+        jnp.where(sep, one, jnp.uint32(constants.HASH_BASE_2)),
+        jnp.where(sep, zero, one),
+    )
+    _, v1, _, v2, _, length = jax.lax.associative_scan(_segmented_combine, elems)
+
+    # A position ends a token iff it is a non-separator whose successor is a
+    # separator or the end of the buffer.
+    next_sep = jnp.concatenate([sep[1:], jnp.ones((1,), dtype=jnp.bool_)])
+    is_end = (~sep) & next_sep
+
+    key_hi = _fmix32(v1 ^ length)
+    key_lo = _fmix32(v2 + jnp.uint32(0x9E3779B9) * length)
+
+    # Clamp away from the sentinel (probability 2**-64 per token).
+    sentinel = jnp.uint32(constants.SENTINEL_KEY)
+    at_sentinel = (key_hi == sentinel) & (key_lo == sentinel)
+    key_lo = jnp.where(at_sentinel, key_lo - one, key_lo)
+
+    # Non-token positions carry the sentinel so they sort to the end.
+    key_hi = jnp.where(is_end, key_hi, sentinel)
+    key_lo = jnp.where(is_end, key_lo, sentinel)
+
+    idx = jax.lax.broadcasted_iota(jnp.uint32, (n, 1), 0).squeeze(-1)
+    base = jnp.asarray(base_offset, dtype=jnp.uint32)
+    start = idx + one - length + base  # first byte of the token
+    return TokenStream(
+        key_hi=key_hi,
+        key_lo=key_lo,
+        count=is_end.astype(jnp.uint32),
+        pos=jnp.where(is_end, start, jnp.uint32(constants.POS_INF)),
+        length=jnp.where(is_end, length, zero),
+    )
+
+
+def token_count(data: jax.Array) -> jax.Array:
+    """Total number of tokens in a flat uint8 buffer (uint32 scalar)."""
+    sep = separator_mask(data)
+    next_sep = jnp.concatenate([sep[1:], jnp.ones((1,), dtype=jnp.bool_)])
+    return jnp.sum(((~sep) & next_sep).astype(jnp.uint32))
+
+
+def pad_to(data: np.ndarray | bytes, size: int) -> np.ndarray:
+    """Host-side: right-pad raw bytes with PAD_BYTE to a static size."""
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    if buf.shape[0] > size:
+        raise ValueError(f"buffer of {buf.shape[0]} bytes exceeds static size {size}")
+    out = np.full((size,), constants.PAD_BYTE, dtype=np.uint8)
+    out[: buf.shape[0]] = buf
+    return out

@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the standard JAX fake-backend idiom (SURVEY §4): multi-chip sharding
+paths are exercised on CPU without TPUs.  Must run before any jax import.
+"""
+
+import os
+
+# Force, don't setdefault: the ambient environment pins JAX_PLATFORMS to the
+# real TPU tunnel, and running the whole suite through one remote chip both
+# crawls and wedges other JAX clients.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)
+
+
+@pytest.fixture(scope="session")
+def fixture_text() -> bytes:
+    """The reference's bundled fixture (test.txt:1-3)."""
+    return b"Hello World EveryOne\nWorld Good News\nGood Morning Hello\n"
+
+
+def make_corpus(rng, n_words: int, vocab: int, zipf_a: float = 1.3, seed_words=None) -> bytes:
+    """Random Zipf-distributed corpus, whitespace-joined."""
+    words = seed_words or [f"w{i:x}" for i in range(vocab)]
+    idx = rng.zipf(zipf_a, size=n_words) % len(words)
+    seps = np.array([" ", "\n", "\t", "  ", " \r\n"])
+    parts = []
+    for i in idx:
+        parts.append(words[int(i)])
+        parts.append(str(seps[int(rng.integers(0, len(seps)))]))
+    return "".join(parts).encode()
+
+
+@pytest.fixture(scope="session")
+def small_corpus(rng) -> bytes:
+    return make_corpus(rng, n_words=2000, vocab=150)

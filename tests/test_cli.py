@@ -1,0 +1,92 @@
+"""CLI parity tests: stdout contract of main.cu:166-218 (SURVEY §7 'Exact CLI parity')."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+EXPECTED_REFERENCE_STDOUT = (
+    "Input Data:\n"
+    "Hello World EveryOne\n"
+    "World Good News\n"
+    "Good Morning Hello\n"
+    "--------------------------\n"
+    "Hello\t2\n"
+    "World\t2\n"
+    "EveryOne\t1\n"
+    "Good\t2\n"
+    "News\t1\n"
+    "Morning\t1\n"
+    "--------------------------\n"
+    "Total Count:9\n"
+)
+
+
+def _run(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, str(REPO / "main"), *args],
+        capture_output=True, text=True, cwd=cwd,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+    )
+
+
+def test_reference_stdout_parity(tmp_path):
+    fixture = tmp_path / "test.txt"
+    fixture.write_text("Hello World EveryOne\nWorld Good News\nGood Morning Hello\n")
+    r = _run([str(fixture)])
+    assert r.returncode == 0, r.stderr
+    assert r.stdout == EXPECTED_REFERENCE_STDOUT
+
+
+def test_default_filename_is_test_txt(tmp_path):
+    """argv-less run reads ./test.txt, matching the hardcoded name (main.cu:167)."""
+    (tmp_path / "test.txt").write_text("a b a\n")
+    r = _run([], cwd=tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "a\t2" in r.stdout and "Total Count:3" in r.stdout
+
+
+def test_missing_file_is_an_error(tmp_path):
+    """The reference silently prints an empty result on fopen failure
+    (main.cu:174); we surface the failure (SURVEY §5 failure detection)."""
+    r = _run([str(tmp_path / "nope.txt")])
+    assert r.returncode == 2
+    assert "cannot read" in r.stderr
+
+
+def test_json_format(tmp_path):
+    f = tmp_path / "in.txt"
+    f.write_text("x y x z\n")
+    r = _run([str(f), "--format", "json"])
+    assert r.returncode == 0, r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["counts"] == [["x", 2], ["y", 1], ["z", 1]]
+    assert obj["total"] == 4 and obj["distinct"] == 3
+
+
+def test_json_distinct_bytes_stay_distinct(tmp_path):
+    """Two invalid-UTF8 byte words must not collapse into one JSON entry."""
+    f = tmp_path / "in.bin"
+    f.write_bytes(b"\xff \xfe\n")
+    r = _run([str(f), "--format", "json"])
+    assert r.returncode == 0, r.stderr
+    obj = json.loads(r.stdout)
+    assert len(obj["counts"]) == 2 and obj["distinct"] == 2
+
+
+def test_bad_chunk_bytes_is_clean_error(tmp_path):
+    f = tmp_path / "in.txt"
+    f.write_text("a\n")
+    r = _run([str(f), "--chunk-bytes", "1000"])
+    assert r.returncode == 2
+    assert "chunk_bytes" in r.stderr and "Traceback" not in r.stderr
+
+
+def test_top_k(tmp_path):
+    f = tmp_path / "in.txt"
+    f.write_text("a a a b b c\n")
+    r = _run([str(f), "--top-k", "2", "--format", "tsv"])
+    assert r.returncode == 0, r.stderr
+    assert r.stdout == "a\t3\nb\t2\n"

@@ -1,0 +1,65 @@
+"""Golden-semantics tests against the reference fixture (SURVEY §2, §4).
+
+Expected result for test.txt: Hello 2, World 2, EveryOne 1, Good 2, News 1,
+Morning 1; Total Count 9 — in first-occurrence order, matching the reference
+report loop (main.cu:212-218).
+"""
+
+from mapreduce_tpu.config import SMALL_CONFIG
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.utils import oracle
+
+GOLDEN = [(b"Hello", 2), (b"World", 2), (b"EveryOne", 1), (b"Good", 2), (b"News", 1), (b"Morning", 1)]
+
+
+def test_fixture_counts(fixture_text):
+    r = wordcount.count_words(fixture_text, SMALL_CONFIG)
+    assert list(zip(r.words, r.counts)) == GOLDEN
+    assert r.total == 9
+    assert r.dropped_uniques == 0 and r.dropped_count == 0
+
+
+def test_fixture_matches_oracle(fixture_text):
+    r = wordcount.count_words(fixture_text, SMALL_CONFIG)
+    assert r.as_dict() == oracle.word_counts(fixture_text)
+    assert r.total == oracle.total_count(fixture_text)
+
+
+def test_empty_input():
+    r = wordcount.count_words(b"", SMALL_CONFIG)
+    assert r.words == [] and r.total == 0
+
+
+def test_only_separators():
+    r = wordcount.count_words(b"  \n\t \r\n  ", SMALL_CONFIG)
+    assert r.words == [] and r.total == 0
+
+
+def test_single_word_no_newline():
+    r = wordcount.count_words(b"hello", SMALL_CONFIG)
+    assert list(zip(r.words, r.counts)) == [(b"hello", 1)]
+    assert r.total == 1
+
+
+def test_reference_defects_fixed(fixture_text):
+    """Defects from SURVEY §2 must be FIXED, not replicated."""
+    # Defect 2: prefix comparator — "Good" must not merge into "Goodness".
+    r = wordcount.count_words(b"Goodness Good Goodness Good Good", SMALL_CONFIG)
+    assert r.as_dict() == {b"Goodness": 2, b"Good": 3}
+    # Defect 5: a line shorter than 2 chars must NOT terminate ingestion.
+    r = wordcount.count_words(b"alpha beta\nx\ngamma delta\n", SMALL_CONFIG)
+    assert r.as_dict() == {b"alpha": 1, b"beta": 1, b"x": 1, b"gamma": 1, b"delta": 1}
+    # Defect 4/5: >10 lines, >10 distinct words, words >=20 chars, >20 words
+    # per line, lines >=100 chars all work.
+    long_word = b"a" * 64
+    lines = [b" ".join(b"w%d" % (i * 30 + j) for j in range(30)) for i in range(20)]
+    data = b"\n".join(lines) + b"\n" + long_word + b"\n"
+    r = wordcount.count_words(data, SMALL_CONFIG)
+    assert r.total == 20 * 30 + 1
+    assert r.as_dict()[long_word] == 1
+    assert len(r.words) == 601
+
+
+def test_tabs_are_separators():
+    r = wordcount.count_words(b"a\tb\tc a", SMALL_CONFIG)
+    assert r.as_dict() == {b"a": 2, b"b": 1, b"c": 1}

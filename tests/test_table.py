@@ -1,0 +1,141 @@
+"""Unit + property tests for CountTable (mapreduce_tpu/ops/table.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_tpu import constants
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.ops import table as tbl
+from mapreduce_tpu.ops import tokenize as tok
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+
+def _stream(data: bytes):
+    return tok.tokenize(jnp.asarray(np.frombuffer(data, dtype=np.uint8)))
+
+
+def _to_dict(t: tbl.CountTable):
+    """{(key_hi, key_lo): count} for occupied slots."""
+    c = np.asarray(t.count)
+    hi, lo = np.asarray(t.key_hi), np.asarray(t.key_lo)
+    return {(int(h), int(l)): int(n) for h, l, n in zip(hi, lo, c) if n > 0}
+
+
+def test_empty_table():
+    t = tbl.empty(16)
+    assert int(t.n_valid()) == 0
+    assert int(t.total_count()) == 0
+    assert np.all(np.asarray(t.key_hi) == constants.SENTINEL_KEY)
+
+
+def test_from_stream_counts(small_corpus):
+    t = tbl.from_stream(_stream(small_corpus), 1024)
+    expected = oracle.word_counts(small_corpus)
+    assert int(t.n_valid()) == len(expected)
+    assert sorted(_to_dict(t).values(), reverse=True) == sorted(expected.values(), reverse=True)
+    assert int(t.total_count()) == oracle.total_count(small_corpus)
+
+
+def test_table_sorted_with_sentinel_tail(small_corpus):
+    t = tbl.from_stream(_stream(small_corpus), 1024)
+    hi, lo = np.asarray(t.key_hi), np.asarray(t.key_lo)
+    keys = [(int(h) << 32) | int(l) for h, l in zip(hi, lo)]
+    assert keys == sorted(keys)
+    n = int(t.n_valid())
+    assert np.all(np.asarray(t.count)[n:] == 0)
+
+
+def test_merge_equals_whole(rng):
+    a = make_corpus(rng, 500, 80)
+    b = make_corpus(rng, 700, 80)
+    ta = tbl.from_stream(_stream(a), 512)
+    tc = tbl.from_stream(_stream(b), 512)
+    merged = tbl.merge(ta, tc, 512)
+    whole = tbl.from_stream(_stream(a + b" " + b), 512)
+    assert _to_dict(merged) == _to_dict(whole)
+    assert int(merged.total_count()) == int(whole.total_count())
+
+
+def test_merge_associative_commutative(rng):
+    parts = [make_corpus(rng, 300, 60) for _ in range(3)]
+    t = [tbl.from_stream(_stream(p), 512) for p in parts]
+    ab_c = tbl.merge(tbl.merge(t[0], t[1], 512), t[2], 512)
+    a_bc = tbl.merge(t[0], tbl.merge(t[1], t[2], 512), 512)
+    c_ba = tbl.merge(t[2], tbl.merge(t[1], t[0], 512), 512)
+    assert _to_dict(ab_c) == _to_dict(a_bc) == _to_dict(c_ba)
+
+
+def test_merge_with_empty_is_identity(small_corpus):
+    t = tbl.from_stream(_stream(small_corpus), 512)
+    m = tbl.merge(t, tbl.empty(512), 512)
+    assert _to_dict(m) == _to_dict(t)
+    assert np.asarray(m.pos_lo)[: int(m.n_valid())].tolist() == \
+           np.asarray(t.pos_lo)[: int(t.n_valid())].tolist()
+
+
+def test_overflow_accounting():
+    """Past capacity: counts spill into dropped_*, never corrupt (cf. main.cu:103-104)."""
+    data = " ".join(f"u{i}" for i in range(100)).encode()
+    t = tbl.from_stream(_stream(data), 32)
+    assert int(t.n_valid()) == 32
+    assert int(t.dropped_uniques) == 68
+    assert int(t.dropped_count) == 68
+    # Conservation: kept + dropped == all tokens.
+    assert int(t.total_count()) == 100
+
+
+def test_count_permutation_invariance(rng):
+    """Counts are invariant under word permutation (SURVEY §4 property test)."""
+    words = [f"w{i % 37}" for i in range(400)]
+    a = " ".join(words).encode()
+    perm = list(words)
+    rng.shuffle(perm)
+    b = " ".join(perm).encode()
+    ta = tbl.from_stream(_stream(a), 128)
+    tc = tbl.from_stream(_stream(b), 128)
+    assert _to_dict(ta) == _to_dict(tc)
+
+
+def test_first_occurrence_position(fixture_text):
+    t = tbl.from_stream(_stream(fixture_text), 64)
+    n = int(t.n_valid())
+    pos = np.asarray(t.pos_lo)[:n]
+    length = np.asarray(t.length)[:n]
+    words = {fixture_text[p: p + l] for p, l in zip(pos, length)}
+    assert words == {b"Hello", b"World", b"EveryOne", b"Good", b"News", b"Morning"}
+    # "World" first occurs at offset 6; "Hello" at 0; "Good" at 27.
+    d = {fixture_text[p: p + l]: int(p) for p, l in zip(pos, length)}
+    assert d[b"Hello"] == 0 and d[b"World"] == 6 and d[b"Good"] == 27
+
+
+def test_update_streaming_equals_batch(rng):
+    corpus = make_corpus(rng, 1000, 100)
+    third = len(corpus) // 3
+    # Split at separator boundaries for a fair comparison.
+    cuts = []
+    for c in (third, 2 * third):
+        while corpus[c] not in b" \t\n\r":
+            c += 1
+        cuts.append(c)
+    pieces = [corpus[: cuts[0]], corpus[cuts[0]: cuts[1]], corpus[cuts[1]:]]
+    t = tbl.empty(512)
+    for p in pieces:
+        t = tbl.update(t, _stream(p), batch_capacity=512)
+    whole = tbl.from_stream(_stream(corpus), 512)
+    assert _to_dict(t) == _to_dict(whole)
+
+
+def test_top_k(small_corpus):
+    t = tbl.from_stream(_stream(small_corpus), 1024)
+    k = tbl.top_k(t, 5)
+    counts = np.asarray(k.count)
+    assert list(counts) == sorted(counts, reverse=True)
+    expected = sorted(oracle.word_counts(small_corpus).values(), reverse=True)[:5]
+    assert counts.tolist() == expected
+
+
+def test_counts_dtype_uint32(small_corpus):
+    t = tbl.from_stream(_stream(small_corpus), 256)
+    assert t.count.dtype == jnp.uint32
