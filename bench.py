@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Benchmark: word-count throughput on a synthetic Zipf corpus (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+The reference publishes no numbers and physically caps at ~5.8 KB of input
+(SURVEY §6), so the baseline here is the natural host-CPU implementation a
+user would reach for (``collections.Counter(data.split())``), measured on a
+slice of the same corpus; ``vs_baseline`` is our GB/s over its GB/s.
+
+Env knobs: BENCH_MB (corpus size, default 128), BENCH_CHUNK_MB (per-device
+step size, default 4), BENCH_BASELINE_MB (CPU baseline slice, default 16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def make_zipf_corpus(n_bytes: int, vocab: int = 50_000, a: float = 1.3,
+                     seed: int = 7) -> bytes:
+    rng = np.random.default_rng(seed)
+    words = np.array([b"w%d" % i for i in range(vocab)], dtype=object)
+    # Average word ~6 bytes + separator; oversample then trim.
+    n_words = int(n_bytes / 6.5) + 1024
+    idx = rng.zipf(a, size=n_words).astype(np.int64) % vocab
+    blob = b" ".join(words[idx])
+    return blob[:n_bytes].rsplit(b" ", 1)[0] + b"\n"
+
+
+def cpu_baseline_gbps(data: bytes, repeats: int = 1) -> float:
+    from collections import Counter
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        counts = Counter(data.split())
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        assert counts  # keep it honest
+    return len(data) / 1e9 / best
+
+
+def main() -> int:
+    mb = int(os.environ.get("BENCH_MB", "128"))
+    chunk_mb = int(os.environ.get("BENCH_CHUNK_MB", "4"))
+    base_mb = int(os.environ.get("BENCH_BASELINE_MB", "16"))
+
+    corpus = make_zipf_corpus(mb << 20)
+
+    import jax
+
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.data import reader
+    from mapreduce_tpu.models.wordcount import WordCountJob
+    from mapreduce_tpu.parallel.mapreduce import Engine
+    from mapreduce_tpu.parallel.mesh import data_mesh
+
+    cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18)
+    mesh = data_mesh()
+    n_dev = mesh.devices.size
+    engine = Engine(WordCountJob(cfg), mesh)
+
+    with tempfile.NamedTemporaryFile(dir="/tmp", suffix=".txt", delete=False) as f:
+        f.write(corpus)
+        path = f.name
+    try:
+        batches = list(reader.iter_batches(path, n_dev, cfg.chunk_bytes))
+        state = engine.init_states()
+        # Warm-up step: pays XLA compile; excluded from steady-state timing.
+        state = engine.step(state, batches[0].data, 0)
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        done = int(batches[0].lengths.sum())
+        for b in batches[1:]:
+            state = engine.step(state, b.data, b.step)
+            done += int(b.lengths.sum())
+        table = engine.finish(state)
+        jax.block_until_ready(table)
+        dt = time.perf_counter() - t0
+        steady_bytes = done - int(batches[0].lengths.sum())
+        gbps = steady_bytes / 1e9 / dt
+        total_words = int(np.asarray(table.total_count()))
+        words_per_s = total_words * (steady_bytes / len(corpus)) / dt
+    finally:
+        os.unlink(path)
+
+    base = cpu_baseline_gbps(corpus[: base_mb << 20])
+
+    print(json.dumps({
+        "metric": "zipf_wordcount_throughput",
+        "value": round(gbps, 4),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / base, 3) if base else 0.0,
+        "corpus_mb": mb,
+        "devices": n_dev,
+        "backend": jax.devices()[0].platform,
+        "total_words": total_words,
+        "cpu_baseline_gbps": round(base, 4),
+        "words_per_s": round(words_per_s, 0),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

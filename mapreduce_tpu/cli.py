@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'reference' replicates the CUDA program's stdout shape")
     p.add_argument("--no-echo", action="store_true",
                    help="suppress the 'Input Data:' echo (for large corpora)")
+    p.add_argument("--stream", action="store_true",
+                   help="use the sharded streaming executor (for large files)")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="with --stream: checkpoint state to PATH and resume from it")
+    p.add_argument("--checkpoint-every", type=int, default=25, metavar="STEPS")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
     return p
 
@@ -50,12 +55,35 @@ def _decode(words: list[bytes]) -> list[str]:
     return [w.decode("utf-8", errors="backslashreplace") for w in words]
 
 
+def _echo_file(path: str) -> None:
+    """Stream the input bytes to stdout (the reference's line echo,
+    main.cu:180) without materializing the file in memory."""
+    sys.stdout.write("Input Data:\n")
+    sys.stdout.flush()
+    last = b"\n"
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            sys.stdout.buffer.write(block)
+            last = block[-1:]
+    if last != b"\n":
+        sys.stdout.buffer.write(b"\n")
+    sys.stdout.buffer.flush()
+
+
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     parser = build_parser()
     args = parser.parse_args(argv)
     try:
+        # Probe readability up front (the reference silently succeeds on
+        # fopen failure, main.cu:174); stream mode never loads the whole file.
         with open(args.input, "rb") as f:
-            data = f.read()
+            data = None if args.stream else f.read()
+        input_bytes = os.path.getsize(args.input)
     except OSError as e:
         print(f"error: cannot read {args.input}: {e}", file=sys.stderr)
         return 2
@@ -66,24 +94,29 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(str(e))
 
     t0 = time.perf_counter()
-    from mapreduce_tpu.models import wordcount
+    if args.stream:
+        from mapreduce_tpu.runtime.executor import count_file
 
-    result = wordcount.count_words(data, config)
+        result = count_file(args.input, config=config, top_k=args.top_k or None,
+                            checkpoint_path=args.checkpoint,
+                            checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
+    else:
+        from mapreduce_tpu.models import wordcount
+
+        result = wordcount.count_words(data, config)
     elapsed = time.perf_counter() - t0
 
+    if args.top_k and not args.stream:  # stream mode already applied top-k
+        from mapreduce_tpu.models.wordcount import apply_top_k
+
+        result = apply_top_k(result, args.top_k)
     words, counts = result.words, result.counts
-    if args.top_k:
-        order = sorted(range(len(words)), key=lambda i: -counts[i])[: args.top_k]
-        words = [words[i] for i in order]
-        counts = [counts[i] for i in order]
 
     out = sys.stdout
     display = _decode(words)
     if args.format == "reference":
         if not args.no_echo:
-            out.write("Input Data:\n")
-            text = data.decode("utf-8", errors="replace")
-            out.write(text if text.endswith("\n") or not text else text + "\n")
+            _echo_file(args.input)
         out.write("--------------------------\n")
         for w, c in zip(display, counts):
             out.write(f"{w}\t{c}\n")
@@ -98,14 +131,14 @@ def main(argv: list[str] | None = None) -> int:
         out.write(json.dumps({
             "counts": [[w, c] for w, c in zip(display, counts)],
             "total": result.total,
-            "distinct": len(result.words),
+            "distinct": result.distinct,
             "dropped_uniques": result.dropped_uniques,
             "dropped_count": result.dropped_count,
         }) + "\n")
 
     if args.stats:
-        gb = len(data) / 1e9
-        print(f"[stats] {len(data)} bytes, {result.total} words, "
+        gb = input_bytes / 1e9
+        print(f"[stats] {input_bytes} bytes, {result.total} words, "
               f"{elapsed:.3f}s, {gb / elapsed:.3f} GB/s", file=sys.stderr)
     return 0
 

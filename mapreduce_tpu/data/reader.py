@@ -1,0 +1,115 @@
+"""Boundary-aligned sharded ingest.
+
+Replaces the reference's host input pipeline — ``fopen``/``fgets`` with a
+100-byte line buffer and per-char splitting (``main.cu:166-207``) — with a
+memory-mapped, token-boundary-aligned chunker: each streaming step yields a
+``uint8[n_shards, chunk_bytes]`` batch (one row per device) plus the absolute
+file offset of every row, so device-side token positions can be mapped back to
+exact byte ranges for string recovery.
+
+Alignment rule: a row may only end at a separator byte, so no token ever spans
+two rows and no cross-chunk fix-up exchange is needed (SURVEY §7 "hard parts":
+the seam problem is solved at ingest, where the bytes already are, instead of
+with a device-side halo exchange).  Tokens longer than ``max_token_bytes`` are
+force-split (and counted as two tokens) rather than stalling the pipeline; the
+reference would overflow a stack buffer in that case (``main.cu:184,199``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from mapreduce_tpu import constants
+
+_SEP_LUT = np.zeros(256, dtype=bool)
+for _b in constants.SEPARATOR_BYTES:
+    _SEP_LUT[_b] = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """One streaming step's input across all shards."""
+
+    data: np.ndarray  # uint8[n_shards, chunk_bytes], zero-padded rows
+    base_offsets: np.ndarray  # int64[n_shards], absolute file offset of row starts
+    lengths: np.ndarray  # int64[n_shards], valid bytes per row
+    step: int
+
+
+def _aligned_cuts(buf: np.ndarray, n_shards: int, chunk_bytes: int,
+                  max_token_bytes: int, at_eof: bool) -> list[int]:
+    """Cut points (ascending, len n_shards) so every row ends at a separator
+    (or at a force-split after max_token_bytes of unbroken non-separators).
+    Only the file's true end (``at_eof``) may cut mid-buffer unaligned — a
+    buffer end mid-file is a carry point, not a token boundary."""
+    is_sep = _SEP_LUT[buf]
+    cuts = []
+    prev = 0
+    n = buf.shape[0]
+    for i in range(n_shards):
+        ideal = min(prev + chunk_bytes, n)
+        if ideal >= n and at_eof:
+            cuts.append(n)
+            prev = n
+            continue
+        lo = max(prev, ideal - max_token_bytes)
+        window = is_sep[lo:ideal]
+        hits = np.flatnonzero(window)
+        # Cut just after the last separator in the window; if the window is
+        # all token bytes, force-split at the ideal point.
+        cut = lo + int(hits[-1]) + 1 if hits.size else ideal
+        cuts.append(cut)
+        prev = cut
+    return cuts
+
+
+def iter_batches(path: str, n_shards: int, chunk_bytes: int,
+                 max_token_bytes: int = 4096, start_offset: int = 0,
+                 start_step: int = 0) -> Iterator[Batch]:
+    """Stream a file as boundary-aligned [n_shards, chunk_bytes] batches.
+
+    ``start_offset``/``start_step`` support checkpoint resume: iteration
+    continues from a previously reported cursor.
+    """
+    mm = np.memmap(path, dtype=np.uint8, mode="r") if _file_size(path) else None
+    total = 0 if mm is None else mm.shape[0]
+    offset = start_offset
+    step = start_step
+    stride = n_shards * chunk_bytes
+    while offset < total:
+        raw = np.asarray(mm[offset: offset + stride])
+        cuts = _aligned_cuts(raw, n_shards, chunk_bytes, max_token_bytes,
+                             at_eof=offset + raw.shape[0] >= total)
+        data = np.zeros((n_shards, chunk_bytes), dtype=np.uint8)
+        bases = np.zeros((n_shards,), dtype=np.int64)
+        lengths = np.zeros((n_shards,), dtype=np.int64)
+        prev = 0
+        for i, cut in enumerate(cuts):
+            row = raw[prev:cut]
+            data[i, : row.shape[0]] = row
+            bases[i] = offset + prev
+            lengths[i] = row.shape[0]
+            prev = cut
+        yield Batch(data=data, base_offsets=bases, lengths=lengths, step=step)
+        consumed = cuts[-1]
+        if consumed == 0:  # defensive: cannot happen (first cut >= 1 byte)
+            raise RuntimeError("ingest made no progress")
+        offset += consumed
+        step += 1
+
+
+def _file_size(path: str) -> int:
+    import os
+
+    return os.path.getsize(path)
+
+
+def read_words_at(path: str, spans: list[tuple[int, int]]) -> list[bytes]:
+    """Host-side string recovery: exact bytes for (absolute_offset, length)."""
+    if not spans:
+        return []
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    return [bytes(mm[off: off + ln]) for off, ln in spans]

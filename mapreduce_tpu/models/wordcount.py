@@ -29,14 +29,29 @@ from mapreduce_tpu.ops import tokenize as tok_ops
 class WordCountResult:
     """Host-side result with recovered strings, insertion-ordered."""
 
-    words: list[bytes]  # distinct words, by first occurrence
+    words: list[bytes]  # reported words, by first occurrence
     counts: list[int]  # parallel to words
     total: int  # total tokens (includes any spilled ones)
+    distinct: int  # distinct words seen (reported + spilled), top-k invariant
     dropped_uniques: int  # diagnostic: distinct words spilled past capacity
     dropped_count: int  # tokens belonging to spilled words
 
     def as_dict(self) -> dict[bytes, int]:
         return dict(zip(self.words, self.counts))
+
+
+def apply_top_k(result: WordCountResult, k: int) -> WordCountResult:
+    """Restrict a result to its k most frequent words (host-side, stable).
+
+    The single owner of top-k reordering for host results; ``total`` keeps
+    counting every token, matching CountTable.total_count() semantics.
+    """
+    order = sorted(range(len(result.words)), key=lambda i: -result.counts[i])[:k]
+    return dataclasses.replace(
+        result,
+        words=[result.words[i] for i in order],
+        counts=[result.counts[i] for i in order],
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("capacity",))
@@ -62,11 +77,13 @@ def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
     cnt = count[valid]
     order = np.argsort(pos, kind="stable")
     words = [bytes(source[int(p): int(p) + int(l)]) for p, l in zip(pos[order], length[order])]
+    dropped_uniques = int(np.asarray(tbl.dropped_uniques))
     return WordCountResult(
         words=words,
         counts=[int(c) for c in cnt[order]],
         total=int(np.asarray(tbl.total_count())),
-        dropped_uniques=int(np.asarray(tbl.dropped_uniques)),
+        distinct=len(words) + dropped_uniques,
+        dropped_uniques=dropped_uniques,
         dropped_count=int(np.asarray(tbl.dropped_count)),
     )
 
@@ -74,3 +91,46 @@ def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
 def count_words(data: bytes, config: Config = DEFAULT_CONFIG) -> WordCountResult:
     """The one-call API: exact word counts for an in-memory buffer."""
     return recover_result(count_table(data, config), data)
+
+
+class WordCountJob:
+    """WordCount as a :class:`mapreduce_tpu.parallel.mapreduce.MapReduceJob`.
+
+    The flagship job: per-device accumulation into a CountTable, associative
+    table merge as the global reduction.  ``chunk_id`` (step * n_devices +
+    device) becomes ``pos_hi`` so first-occurrence order is global file order
+    and the executor can recover exact strings from (chunk_id, pos_lo, len).
+    """
+
+    def __init__(self, config: Config = DEFAULT_CONFIG):
+        self.config = config
+        self.capacity = config.table_capacity
+        self.batch_capacity = config.batch_uniques
+
+    def init_state(self) -> table_ops.CountTable:
+        return table_ops.empty(self.capacity)
+
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
+        stream = tok_ops.tokenize(chunk)
+        return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
+
+    def combine(self, state, update):
+        return table_ops.merge(state, update, capacity=self.capacity)
+
+    def merge(self, a, b):
+        return table_ops.merge(a, b, capacity=self.capacity)
+
+    def finalize(self, state):
+        return state
+
+
+class TopKWordCountJob(WordCountJob):
+    """WordCount whose device-side finalize keeps only the k most frequent
+    words (the Common-Crawl top-k benchmark config, BASELINE.md)."""
+
+    def __init__(self, k: int, config: Config = DEFAULT_CONFIG):
+        super().__init__(config)
+        self.k = k
+
+    def finalize(self, state):
+        return table_ops.top_k(state, self.k)

@@ -152,11 +152,20 @@ def update(table: CountTable, stream: TokenStream, batch_capacity: int,
 
 
 def top_k(table: CountTable, k: int) -> CountTable:
-    """The k most frequent keys, as a (count-descending) table of capacity k."""
+    """The k most frequent keys, as a count-descending table of capacity k.
+
+    A *terminal* op: the result is sorted by count, not by key, so it must not
+    be merged further.  Evicted entries are folded into ``dropped_*`` so
+    ``total_count()`` remains exact (total tokens, not just the top-k's).
+    """
     order = jnp.argsort(jnp.uint32(0xFFFFFFFF) - table.count)[:k]
     take = lambda f: f[order]
+    kept_count = take(table.count)
+    evicted_count = jnp.sum(table.count) - jnp.sum(kept_count)
+    evicted_uniques = table.n_valid() - jnp.sum((kept_count > 0).astype(jnp.uint32))
     return CountTable(
-        key_hi=take(table.key_hi), key_lo=take(table.key_lo), count=take(table.count),
+        key_hi=take(table.key_hi), key_lo=take(table.key_lo), count=kept_count,
         pos_hi=take(table.pos_hi), pos_lo=take(table.pos_lo), length=take(table.length),
-        dropped_uniques=table.dropped_uniques, dropped_count=table.dropped_count,
+        dropped_uniques=table.dropped_uniques + evicted_uniques,
+        dropped_count=table.dropped_count + evicted_count,
     )

@@ -1,0 +1,66 @@
+"""Collective reductions over arbitrary mergeable states.
+
+This is the distributed communication backend of the framework — the role the
+reference fills with one serial device thread scanning every emitted pair
+(``reduceKernel``/``reducer``, ``main.cu:119-123,69-108``) plus PCIe
+``cudaMemcpy`` (``main.cu:147,157-158``).  Here cross-device aggregation is
+expressed as XLA collectives over the mesh (ICI within a slice, DCN across
+slices), in three interchangeable strategies:
+
+* :func:`tree_merge` — butterfly all-reduce built from ``ppermute`` rounds
+  with a user merge function.  log2(D) rounds; requires a power-of-two axis.
+  The generalization of ``psum`` to non-additive monoids (count tables).
+* :func:`gather_merge` — ``all_gather`` + fold.  Works for any axis size;
+  O(D) memory; the fallback and the simplest correct form.
+* ``psum`` — used directly wherever the state really is additive (scalar
+  totals, sketch matrices, histogram vectors); XLA lowers it to the native
+  ICI all-reduce (the BASELINE.json north-star transformation).
+
+All functions take *pytrees* and must be called inside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+T = TypeVar("T")
+MergeFn = Callable[[T, T], T]
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
+    """Butterfly all-reduce: after log2(D) ppermute+merge rounds every device
+    holds the merge of all D states.  Deterministic and replicated.
+    """
+    n = jax.lax.axis_size(axis)
+    if n & (n - 1):
+        return gather_merge(state, merge, axis)
+    rounds = n.bit_length() - 1
+    for r in range(rounds):
+        bit = 1 << r
+        perm = [(i, i ^ bit) for i in range(n)]
+        partner = jax.tree.map(lambda x: jax.lax.ppermute(x, axis, perm), state)
+        state = merge(state, partner)
+    return state
+
+
+def gather_merge(state: T, merge: MergeFn, axis: str) -> T:
+    """all_gather every state then fold left.  Any axis size; replicated."""
+    n = jax.lax.axis_size(axis)
+    gathered = jax.tree.map(lambda x: jax.lax.all_gather(x, axis), state)
+    take = lambda i: jax.tree.map(lambda x: x[i], gathered)
+    acc = take(0)
+    for i in range(1, n):
+        acc = merge(acc, take(i))
+    return acc
+
+
+def psum(state: T, axis: str) -> T:
+    """Additive all-reduce of a pytree (native XLA collective)."""
+    return jax.lax.psum(state, axis)

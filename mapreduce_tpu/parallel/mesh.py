@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+The reference is single-device/single-stream by construction (SURVEY §2:
+"no multi-GPU or multi-node support").  Here the device topology is a
+first-class object: a 1-D data-parallel `jax.sharding.Mesh` by default, with
+room for multi-axis meshes (e.g. ('replica', 'data')) on multi-slice pods
+where the outer axis rides DCN and the inner rides ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_mesh(n_devices: int | None = None, axis: str = "data",
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def sharded(mesh: Mesh, *axes: str | None) -> NamedSharding:
+    """NamedSharding shorthand: sharded(mesh, 'data') == P('data') on mesh."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,101 @@
+"""Checkpoint / resume for streaming runs.
+
+The reference is single-shot batch with no persistence (SURVEY §5: all state
+freed at exit, ``main.cu:219-220``).  For 100 GB-scale corpora the executor
+periodically saves the per-device count state plus the ingest cursor, so a
+failed run resumes from the last shard boundary instead of restarting.
+
+Format: a single ``.npz`` (atomic rename on write) holding the stacked
+CountTable leaves, the ingest cursor (file offset + step index), and the
+per-step row base offsets needed for string recovery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from mapreduce_tpu.ops.table import CountTable
+
+_FIELDS = list(CountTable._fields)
+
+
+class CheckpointMismatch(RuntimeError):
+    """The checkpoint was produced by an incompatible run configuration."""
+
+
+def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int) -> dict:
+    """Identity of a run: resuming under a different identity is an error.
+
+    The input file is fingerprinted by size + a head/tail content hash, so a
+    replaced or appended corpus is detected without rehashing 100 GB.
+    """
+    size = os.path.getsize(input_path)
+    h = hashlib.sha256()
+    with open(input_path, "rb") as f:
+        h.update(f.read(1 << 16))
+        if size > (1 << 16):
+            f.seek(max(0, size - (1 << 16)))
+            h.update(f.read(1 << 16))
+    return {"input_size": size, "input_hash": h.hexdigest(),
+            "n_devices": n_devices, "chunk_bytes": chunk_bytes}
+
+
+def save(path: str, state: CountTable, step: int, offset: int,
+         bases: np.ndarray, fingerprint: dict | None = None) -> None:
+    """Atomically persist a run snapshot.
+
+    Args:
+      state: stacked per-device CountTable (leaves shaped [D, ...]).
+      step: next step index to execute.
+      offset: file offset ingest should resume from.
+      bases: int64[steps_done, D] absolute row base offsets so far.
+      fingerprint: run identity from :func:`run_fingerprint`.
+    """
+    payload = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
+    payload["__step"] = np.int64(step)
+    payload["__offset"] = np.int64(offset)
+    payload["__bases"] = np.asarray(bases, dtype=np.int64)
+    payload["__meta"] = np.frombuffer(
+        json.dumps(fingerprint or {}).encode(), dtype=np.uint8)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str, expect_fingerprint: dict | None = None
+         ) -> tuple[CountTable, int, int, np.ndarray]:
+    """Load a snapshot; returns (state, step, offset, bases).
+
+    If ``expect_fingerprint`` is given, raises :class:`CheckpointMismatch`
+    when the snapshot came from a different input file, device count, or
+    chunk size — silently resuming across those would corrupt counts.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta"]).decode() or "{}") if "__meta" in z else {}
+        if expect_fingerprint:
+            for key, want in expect_fingerprint.items():
+                got = meta.get(key)
+                if got != want:
+                    raise CheckpointMismatch(
+                        f"checkpoint {path} was written with {key}={got!r}, "
+                        f"this run has {key}={want!r}; delete the checkpoint "
+                        f"or rerun with the original configuration")
+        state = CountTable(**{f: z[f] for f in _FIELDS})
+        return state, int(z["__step"]), int(z["__offset"]), z["__bases"]
+
+
+def exists(path: str) -> bool:
+    return os.path.exists(path)

@@ -1,0 +1,43 @@
+"""Structured logging.
+
+The reference's only observability is raw ``printf`` (``main.cu:166-218``,
+SURVEY §5).  Here: a standard ``logging`` logger with an optional one-line
+JSON formatter for machine consumption, plus helpers for progress lines.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "fields", None)
+        if extra:
+            obj.update(extra)
+        return json.dumps(obj)
+
+
+def get_logger(name: str = "mapreduce_tpu", json_lines: bool = False,
+               level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(JsonFormatter() if json_lines else
+                       logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger
+
+
+def log_event(logger: logging.Logger, msg: str, **fields) -> None:
+    logger.info(msg, extra={"fields": fields})

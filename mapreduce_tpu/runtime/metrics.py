@@ -1,0 +1,60 @@
+"""Throughput metrics and per-phase timing.
+
+The reference has no timing at all (``time.h`` included at ``main.cu:6`` but
+never called — SURVEY §5 "Tracing/profiling: absent").  The TPU build reports
+the driver-defined BASELINE metrics: bytes ingested, words counted, GB/s and
+words/sec per phase and end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class PhaseTimer:
+    """Accumulates wall-clock per named phase."""
+
+    phases: dict = dataclasses.field(default_factory=dict)
+    _open: dict = dataclasses.field(default_factory=dict)
+
+    def start(self, name: str) -> None:
+        self._open[name] = time.perf_counter()
+
+    def stop(self, name: str) -> float:
+        dt = time.perf_counter() - self._open.pop(name)
+        self.phases[name] = self.phases.get(name, 0.0) + dt
+        return dt
+
+    def __getitem__(self, name: str) -> float:
+        return self.phases.get(name, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class RunMetrics:
+    """End-of-run throughput summary (the BASELINE.json headline numbers)."""
+
+    bytes_processed: int
+    words_counted: int
+    elapsed_s: float
+    phases: dict
+
+    @property
+    def gb_per_s(self) -> float:
+        return self.bytes_processed / 1e9 / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def words_per_s(self) -> float:
+        return self.words_counted / self.elapsed_s if self.elapsed_s else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "bytes": self.bytes_processed,
+            "words": self.words_counted,
+            "elapsed_s": round(self.elapsed_s, 4),
+            "gb_per_s": round(self.gb_per_s, 4),
+            "words_per_s": round(self.words_per_s, 1),
+            "phases": {k: round(v, 4) for k, v in self.phases.items()},
+        }

@@ -1,0 +1,122 @@
+"""End-to-end streaming executor tests: count_file, checkpoint/resume, metrics."""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models.wordcount import WordCountJob
+from mapreduce_tpu.parallel.mesh import data_mesh
+from mapreduce_tpu.runtime import checkpoint as ckpt
+from mapreduce_tpu.runtime import executor
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+CFG = Config(chunk_bytes=512, table_capacity=2048)
+
+
+def _write(tmp_path, data: bytes):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(data)
+    return str(p)
+
+
+def test_count_file_matches_oracle(tmp_path, rng):
+    corpus = make_corpus(rng, 4000, 250)
+    path = _write(tmp_path, corpus)
+    result = executor.count_file(path, CFG, mesh=data_mesh(8))
+    assert result.as_dict() == oracle.word_counts(corpus)
+    assert result.total == oracle.total_count(corpus)
+
+
+def test_count_file_insertion_order(tmp_path):
+    data = b"pear apple pear cherry apple pear\n"
+    path = _write(tmp_path, data)
+    result = executor.count_file(path, CFG, mesh=data_mesh(2))
+    assert result.words == [b"pear", b"apple", b"cherry"]
+    assert result.counts == [3, 2, 1]
+
+
+def test_count_file_top_k(tmp_path, rng):
+    corpus = make_corpus(rng, 3000, 150)
+    path = _write(tmp_path, corpus)
+    result = executor.count_file(path, CFG, mesh=data_mesh(4), top_k=5)
+    expected = sorted(oracle.word_counts(corpus).values(), reverse=True)[:5]
+    assert result.counts == expected
+
+
+def test_run_metrics(tmp_path, rng):
+    corpus = make_corpus(rng, 2000, 100)
+    path = _write(tmp_path, corpus)
+    rr = executor.run_job(WordCountJob(CFG), path, CFG, mesh=data_mesh(4))
+    assert rr.metrics.bytes_processed == len(corpus)
+    assert rr.metrics.words_counted == oracle.total_count(corpus)
+    assert rr.metrics.elapsed_s > 0 and rr.metrics.gb_per_s > 0
+    assert "stream" in rr.metrics.phases and "reduce" in rr.metrics.phases
+
+
+def test_checkpoint_resume_same_result(tmp_path, rng):
+    """Kill-and-resume produces the identical count multiset (SURVEY §5)."""
+    corpus = make_corpus(rng, 5000, 200)
+    path = _write(tmp_path, corpus)
+    mesh = data_mesh(4)
+    ck = str(tmp_path / "state.npz")
+
+    # Full run, no checkpointing: the golden answer.
+    full = executor.count_file(path, CFG, mesh=mesh)
+
+    # Run with checkpointing every step, then simulate a crash by reloading
+    # from the last checkpoint and re-running.
+    executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck, checkpoint_every=1)
+    assert ckpt.exists(ck)
+    state, step, offset, bases = ckpt.load(ck)
+    assert step > 1 and 0 < offset <= len(corpus)
+
+    resumed = executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck,
+                                  checkpoint_every=1)
+    assert resumed.as_dict() == full.as_dict()
+    assert resumed.total == full.total
+
+
+def test_checkpoint_mismatch_rejected(tmp_path, rng):
+    """Resuming against a replaced input file must fail loudly, not corrupt."""
+    corpus = make_corpus(rng, 3000, 100)
+    path = _write(tmp_path, corpus)
+    ck = str(tmp_path / "state.npz")
+    small = Config(chunk_bytes=256, table_capacity=1024)
+    executor.count_file(path, small, mesh=data_mesh(2), checkpoint_path=ck,
+                        checkpoint_every=1)
+    # Replace the input: same path, different content.
+    (tmp_path / "corpus.txt").write_bytes(make_corpus(rng, 3000, 100))
+    with pytest.raises(ckpt.CheckpointMismatch):
+        executor.count_file(path, small, mesh=data_mesh(2), checkpoint_path=ck,
+                            checkpoint_every=1)
+    # Different device count is also rejected.
+    with pytest.raises(ckpt.CheckpointMismatch):
+        executor.count_file(path, small, mesh=data_mesh(4), checkpoint_path=ck,
+                            checkpoint_every=1)
+
+
+def test_stream_top_k_total_is_exact(tmp_path, rng):
+    """--stream --top-k must report the full token total, not the top-k sum."""
+    corpus = make_corpus(rng, 2000, 120)
+    path = _write(tmp_path, corpus)
+    result = executor.count_file(path, CFG, mesh=data_mesh(2), top_k=3)
+    assert result.total == oracle.total_count(corpus)
+    assert result.distinct == len(oracle.word_counts(corpus))
+    assert len(result.words) == 3
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from mapreduce_tpu.ops import table as tbl
+
+    t = tbl.empty(16)
+    import jax
+
+    stacked = jax.tree.map(lambda x: np.broadcast_to(np.asarray(x)[None], (4,) + x.shape), t)
+    p = str(tmp_path / "ck.npz")
+    ckpt.save(p, stacked, step=3, offset=12345, bases=np.zeros((3, 4), np.int64))
+    s2, step, offset, bases = ckpt.load(p)
+    assert step == 3 and offset == 12345 and bases.shape == (3, 4)
+    for f in t._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(stacked, f)),
+                                      np.asarray(getattr(s2, f)))

@@ -1,0 +1,85 @@
+"""Ingest tests: boundary alignment, streaming equivalence, recovery spans."""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu import constants
+from mapreduce_tpu.data import reader
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+SEPS = set(constants.SEPARATOR_BYTES)
+
+
+def _write(tmp_path, data: bytes):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(data)
+    return str(p)
+
+
+def test_rows_end_at_separators(tmp_path, rng):
+    corpus = make_corpus(rng, 3000, 100)
+    path = _write(tmp_path, corpus)
+    for batch in reader.iter_batches(path, 4, 256):
+        for i in range(4):
+            ln = int(batch.lengths[i])
+            if ln == 0 or int(batch.base_offsets[i]) + ln >= len(corpus):
+                continue  # empty row or end of file
+            assert int(batch.data[i, ln - 1]) in SEPS, "row must end at a separator"
+
+
+def test_batches_cover_file_exactly(tmp_path, rng):
+    corpus = make_corpus(rng, 2000, 90)
+    path = _write(tmp_path, corpus)
+    reconstructed = bytearray()
+    for batch in reader.iter_batches(path, 3, 128):
+        for i in range(3):
+            ln = int(batch.lengths[i])
+            assert int(batch.base_offsets[i]) == len(reconstructed)
+            reconstructed += bytes(batch.data[i, :ln])
+    assert bytes(reconstructed) == corpus
+
+
+def test_no_token_split_across_rows(tmp_path, rng):
+    corpus = make_corpus(rng, 4000, 150)
+    path = _write(tmp_path, corpus)
+    words_streamed = []
+    for batch in reader.iter_batches(path, 5, 192):
+        for i in range(5):
+            ln = int(batch.lengths[i])
+            words_streamed.extend(oracle.split_words(bytes(batch.data[i, :ln])))
+    assert words_streamed == oracle.split_words(corpus)
+
+
+def test_force_split_monster_token(tmp_path):
+    """A token longer than max_token_bytes is split, not a stall/overflow
+    (the reference would smash its 20-byte stack buffer, main.cu:184)."""
+    data = b"a" * 10_000 + b" end"
+    path = _write(tmp_path, data)
+    batches = list(reader.iter_batches(path, 2, 512, max_token_bytes=256))
+    total = sum(int(b.lengths.sum()) for b in batches)
+    assert total == len(data)
+
+
+def test_empty_file(tmp_path):
+    path = _write(tmp_path, b"")
+    assert list(reader.iter_batches(path, 4, 128)) == []
+
+
+def test_resume_cursor(tmp_path, rng):
+    corpus = make_corpus(rng, 1000, 50)
+    path = _write(tmp_path, corpus)
+    full = list(reader.iter_batches(path, 2, 128))
+    # Stop after 2 steps, resume from the reported cursor.
+    consumed = sum(int(b.lengths.sum()) for b in full[:2])
+    resumed = list(reader.iter_batches(path, 2, 128, start_offset=consumed, start_step=2))
+    assert [b.step for b in resumed] == [b.step for b in full[2:]]
+    for a, b in zip(resumed, full[2:]):
+        np.testing.assert_array_equal(a.data, b.data)
+        np.testing.assert_array_equal(a.base_offsets, b.base_offsets)
+
+
+def test_read_words_at(tmp_path):
+    path = _write(tmp_path, b"alpha beta gamma")
+    assert reader.read_words_at(path, [(0, 5), (6, 4), (11, 5)]) == \
+        [b"alpha", b"beta", b"gamma"]

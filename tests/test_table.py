@@ -136,6 +136,15 @@ def test_top_k(small_corpus):
     assert counts.tolist() == expected
 
 
+def test_top_k_preserves_totals(small_corpus):
+    """Evicted entries fold into dropped_*; total_count() stays exact."""
+    t = tbl.from_stream(_stream(small_corpus), 1024)
+    k = tbl.top_k(t, 5)
+    assert int(k.total_count()) == oracle.total_count(small_corpus)
+    n_distinct = len(oracle.word_counts(small_corpus))
+    assert int(k.dropped_uniques) == n_distinct - 5
+
+
 def test_counts_dtype_uint32(small_corpus):
     t = tbl.from_stream(_stream(small_corpus), 256)
     assert t.count.dtype == jnp.uint32
