@@ -8,11 +8,22 @@ import os
 
 # Force, don't setdefault: the ambient environment pins JAX_PLATFORMS to the
 # real TPU tunnel, and running the whole suite through one remote chip both
-# crawls and wedges other JAX clients.
+# crawls and wedges other JAX clients.  The interpreter startup may import jax
+# before this conftest runs (sitecustomize), so env vars alone are too late for
+# jax_platforms — but the *backend* initializes lazily, so config.update plus
+# XLA_FLAGS still land as long as no jax.devices()/computation ran yet.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "tests require the 8-device virtual CPU mesh; a JAX backend was already "
+    "initialized before conftest.py could configure it"
+)
 
 import numpy as np
 import pytest
