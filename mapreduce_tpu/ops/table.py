@@ -19,6 +19,13 @@ Invariants of a well-formed table (established by every constructor here):
   * overflow past capacity is *accounted* (``dropped_count`` exact,
     ``dropped_uniques`` an upper bound), never silent corruption like the
     reference past MAX_OUTPUT_COUNT (``main.cu:103-104``).
+
+Count envelope: per-key counts and the ``dropped_*`` scalars are uint32
+device accumulators (JAX default-x64 is off, so uint64 is unavailable on
+device), giving an exact ceiling of 2**32-1 occurrences *per word* and per
+spill counter — ~4.29e9, i.e. ≳30 GB of a single repeated word before wrap.
+Host-side totals (:meth:`CountTable.total_count` on fetched tables) are
+summed in int64 and stay exact across the whole corpus.
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mapreduce_tpu import constants
 from mapreduce_tpu.ops.tokenize import TokenStream
@@ -51,8 +59,15 @@ class CountTable(NamedTuple):
     def n_valid(self) -> jax.Array:
         return jnp.sum((self.count > 0).astype(jnp.uint32))
 
-    def total_count(self) -> jax.Array:
-        """Total tokens represented, including spilled ones."""
+    def total_count(self) -> jax.Array | int:
+        """Total tokens represented, including spilled ones.
+
+        On host tables (numpy leaves, e.g. after fetching a result) the sum is
+        exact in int64; on device the accumulator dtype is uint32 (see module
+        docstring for the envelope), matching what jit can trace.
+        """
+        if isinstance(self.count, np.ndarray):
+            return int(self.count.astype(np.int64).sum()) + int(self.dropped_count)
         return jnp.sum(self.count) + self.dropped_count
 
 
@@ -157,8 +172,12 @@ def top_k(table: CountTable, k: int) -> CountTable:
     A *terminal* op: the result is sorted by count, not by key, so it must not
     be merged further.  Evicted entries are folded into ``dropped_*`` so
     ``total_count()`` remains exact (total tokens, not just the top-k's).
+    Ties break by first occurrence (ascending ``pos``), matching the host-side
+    :func:`mapreduce_tpu.models.wordcount.apply_top_k` so streamed and
+    single-buffer runs report identical word sets.
     """
-    order = jnp.argsort(jnp.uint32(0xFFFFFFFF) - table.count)[:k]
+    neg = jnp.uint32(0xFFFFFFFF) - table.count
+    order = jnp.lexsort((table.pos_lo, table.pos_hi, neg))[:k]
     take = lambda f: f[order]
     kept_count = take(table.count)
     evicted_count = jnp.sum(table.count) - jnp.sum(kept_count)

@@ -30,10 +30,6 @@ T = TypeVar("T")
 MergeFn = Callable[[T, T], T]
 
 
-def axis_size(axis: str) -> int:
-    return jax.lax.axis_size(axis)
-
-
 def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
     """Butterfly all-reduce: after log2(D) ppermute+merge rounds every device
     holds the merge of all D states.  Deterministic and replicated.

@@ -32,10 +32,11 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from mapreduce_tpu.parallel import collectives
+from mapreduce_tpu.parallel import mesh as mesh_mod
 
 
 class MapReduceJob:
@@ -84,8 +85,8 @@ class Engine:
             raise ValueError(f"unknown merge_strategy {merge_strategy!r}")
         self._collective = (collectives.tree_merge if merge_strategy == "tree"
                             else collectives.gather_merge)
-        self._sharded = NamedSharding(mesh, P(axis))
-        self._replicated = NamedSharding(mesh, P())
+        self._sharded = mesh_mod.sharded(mesh, axis)
+        self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
         self._finish_fn = None
 

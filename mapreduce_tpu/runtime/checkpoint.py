@@ -33,6 +33,8 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int) -> dict:
 
     The input file is fingerprinted by size + a head/tail content hash, so a
     replaced or appended corpus is detected without rehashing 100 GB.
+    Table capacity is deliberately not part of the dict: it is validated
+    against the saved arrays' actual shape (ground truth) by the executor.
     """
     size = os.path.getsize(input_path)
     h = hashlib.sha256()

@@ -65,6 +65,14 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         state_np, start_step, start_offset, bases_arr = ckpt_mod.load(
             checkpoint_path, expect_fingerprint=fingerprint)
+        saved_cap = state_np.key_hi.shape[-1]
+        if saved_cap != config.table_capacity:
+            # Shapes are ground truth: merging a restored wide table into a
+            # narrower accumulator would silently spill entries mid-run.
+            raise ckpt_mod.CheckpointMismatch(
+                f"checkpoint {checkpoint_path} has table_capacity={saved_cap}, "
+                f"this run has {config.table_capacity}; delete the checkpoint "
+                f"or rerun with the original configuration")
         state = jax.device_put(state_np, engine._sharded)
         bases_list = list(bases_arr)
         log_event(logger, "resumed from checkpoint", step=start_step, offset=start_offset)

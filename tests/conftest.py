@@ -21,8 +21,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) >= 8, (
-    "tests require the 8-device virtual CPU mesh; a JAX backend was already "
-    "initialized before conftest.py could configure it"
+    "tests require the 8-device virtual CPU mesh; either a JAX backend was "
+    "initialized before conftest.py could configure it, or the ambient "
+    "XLA_FLAGS already pins xla_force_host_platform_device_count below 8"
 )
 
 import numpy as np

@@ -96,6 +96,33 @@ def test_checkpoint_mismatch_rejected(tmp_path, rng):
                             checkpoint_every=1)
 
 
+def test_checkpoint_capacity_mismatch_rejected(tmp_path, rng):
+    """Resuming with a different table_capacity would silently spill entries."""
+    corpus = make_corpus(rng, 3000, 100)
+    path = _write(tmp_path, corpus)
+    ck = str(tmp_path / "state.npz")
+    executor.count_file(path, Config(chunk_bytes=256, table_capacity=2048),
+                        mesh=data_mesh(2), checkpoint_path=ck, checkpoint_every=1)
+    with pytest.raises(ckpt.CheckpointMismatch):
+        executor.count_file(path, Config(chunk_bytes=256, table_capacity=1024),
+                            mesh=data_mesh(2), checkpoint_path=ck, checkpoint_every=1)
+
+
+def test_stream_and_single_buffer_top_k_agree(tmp_path):
+    """Device-side and host-side top-k must break count ties identically
+    (by first occurrence), so --stream --top-k and --top-k match."""
+    # Five words, counts 3,2,2,2,1: the k=2 boundary lands inside the tie.
+    data = b"aa bb aa cc dd aa bb cc dd bb cc dd ee\n" * 3
+    path = _write(tmp_path, data)
+    streamed = executor.count_file(path, CFG, mesh=data_mesh(2), top_k=2)
+
+    from mapreduce_tpu.models.wordcount import apply_top_k, count_words
+
+    single = apply_top_k(count_words(data), 2)
+    assert streamed.words == single.words
+    assert streamed.counts == single.counts
+
+
 def test_stream_top_k_total_is_exact(tmp_path, rng):
     """--stream --top-k must report the full token total, not the top-k sum."""
     corpus = make_corpus(rng, 2000, 120)
