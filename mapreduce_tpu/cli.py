@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: checkpoint state to PATH and resume from it")
     p.add_argument("--checkpoint-every", type=int, default=25, metavar="STEPS")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
+    p.add_argument("--backend", choices=("xla", "pallas"), default="xla",
+                   help="map-phase implementation (pallas = fused TPU kernel)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
     return p
 
 
@@ -89,21 +93,25 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     try:
-        config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity)
+        config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
+                        backend=args.backend)
     except ValueError as e:
         parser.error(str(e))
 
+    from mapreduce_tpu.runtime import profiling
+
     t0 = time.perf_counter()
-    if args.stream:
-        from mapreduce_tpu.runtime.executor import count_file
+    with profiling.trace(args.profile):
+        if args.stream:
+            from mapreduce_tpu.runtime.executor import count_file
 
-        result = count_file(args.input, config=config, top_k=args.top_k or None,
-                            checkpoint_path=args.checkpoint,
-                            checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
-    else:
-        from mapreduce_tpu.models import wordcount
+            result = count_file(args.input, config=config, top_k=args.top_k or None,
+                                checkpoint_path=args.checkpoint,
+                                checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
+        else:
+            from mapreduce_tpu.models import wordcount
 
-        result = wordcount.count_words(data, config)
+            result = wordcount.count_words(data, config)
     elapsed = time.perf_counter() - t0
 
     if args.top_k and not args.stream:  # stream mode already applied top-k

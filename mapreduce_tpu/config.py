@@ -28,18 +28,37 @@ class Config:
         before merging into the table.  Bounded by tokens-per-chunk; a chunk of
         N bytes has at most ceil(N/2) tokens.
       mesh_axis: name of the data-parallel mesh axis.
+      backend: map-phase implementation — 'xla' (segmented associative scan,
+        any token length) or 'pallas' (fused single-pass TPU kernel; tokens
+        longer than ``pallas_max_token`` bytes are dropped into ``dropped_*``
+        accounting rather than counted).
+      pallas_max_token: W for the pallas backend's on-chip lookback window.
     """
 
     chunk_bytes: int = 1 << 20
     table_capacity: int = 1 << 18
     batch_unique_capacity: Optional[int] = None
     mesh_axis: str = "data"
+    backend: str = "xla"
+    pallas_max_token: int = 32
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
             raise ValueError(f"chunk_bytes must be a multiple of 128, got {self.chunk_bytes}")
         if self.table_capacity < 2:
             raise ValueError("table_capacity must be >= 2")
+        if self.backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.backend == "pallas":
+            if self.pallas_max_token < 1:
+                raise ValueError(
+                    f"pallas_max_token must be >= 1, got {self.pallas_max_token}")
+            # Seam windows must not overlap: lane segment >= 2W+2 bytes.
+            min_chunk = 128 * (2 * self.pallas_max_token + 2)
+            if self.chunk_bytes < min_chunk:
+                raise ValueError(
+                    f"pallas backend needs chunk_bytes >= {min_chunk} "
+                    f"for pallas_max_token={self.pallas_max_token}")
 
     @property
     def batch_uniques(self) -> int:

@@ -68,12 +68,17 @@ def _aligned_cuts(buf: np.ndarray, n_shards: int, chunk_bytes: int,
 
 def iter_batches(path: str, n_shards: int, chunk_bytes: int,
                  max_token_bytes: int = 4096, start_offset: int = 0,
-                 start_step: int = 0) -> Iterator[Batch]:
+                 start_step: int = 0, use_native: bool = True) -> Iterator[Batch]:
     """Stream a file as boundary-aligned [n_shards, chunk_bytes] batches.
 
     ``start_offset``/``start_step`` support checkpoint resume: iteration
-    continues from a previously reported cursor.
+    continues from a previously reported cursor.  The batch fill runs in the
+    native chunker (:mod:`mapreduce_tpu.native`) when available, falling back
+    to the pure-numpy path; both produce byte-identical batches
+    (tests/test_native.py asserts parity).
     """
+    from mapreduce_tpu import native
+
     mm = np.memmap(path, dtype=np.uint8, mode="r") if _file_size(path) else None
     total = 0 if mm is None else mm.shape[0]
     offset = start_offset
@@ -81,20 +86,29 @@ def iter_batches(path: str, n_shards: int, chunk_bytes: int,
     stride = n_shards * chunk_bytes
     while offset < total:
         raw = np.asarray(mm[offset: offset + stride])
-        cuts = _aligned_cuts(raw, n_shards, chunk_bytes, max_token_bytes,
-                             at_eof=offset + raw.shape[0] >= total)
-        data = np.zeros((n_shards, chunk_bytes), dtype=np.uint8)
-        bases = np.zeros((n_shards,), dtype=np.int64)
-        lengths = np.zeros((n_shards,), dtype=np.int64)
-        prev = 0
-        for i, cut in enumerate(cuts):
-            row = raw[prev:cut]
-            data[i, : row.shape[0]] = row
-            bases[i] = offset + prev
-            lengths[i] = row.shape[0]
-            prev = cut
+        at_eof = offset + raw.shape[0] >= total
+        data = np.empty((n_shards, chunk_bytes), dtype=np.uint8)
+        bases = np.empty((n_shards,), dtype=np.int64)
+        lengths = np.empty((n_shards,), dtype=np.int64)
+        consumed = None
+        if use_native:
+            consumed = native.fill_batch(raw, at_eof, n_shards, chunk_bytes,
+                                         max_token_bytes, data.reshape(-1),
+                                         bases, lengths)
+        if consumed is None:
+            data[:] = 0
+            cuts = _aligned_cuts(raw, n_shards, chunk_bytes, max_token_bytes,
+                                 at_eof=at_eof)
+            prev = 0
+            for i, cut in enumerate(cuts):
+                row = raw[prev:cut]
+                data[i, : row.shape[0]] = row
+                bases[i] = prev
+                lengths[i] = row.shape[0]
+                prev = cut
+            consumed = cuts[-1]
+        bases += offset
         yield Batch(data=data, base_offsets=bases, lengths=lengths, step=step)
-        consumed = cuts[-1]
         if consumed == 0:  # defensive: cannot happen (first cut >= 1 byte)
             raise RuntimeError("ingest made no progress")
         offset += consumed

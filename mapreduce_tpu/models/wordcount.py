@@ -54,18 +54,33 @@ def apply_top_k(result: WordCountResult, k: int) -> WordCountResult:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("capacity",))
-def _count_step(data: jax.Array, capacity: int) -> table_ops.CountTable:
-    stream = tok_ops.tokenize(data)
-    return table_ops.from_stream(stream, capacity)
+def _map_stream(chunk: jax.Array, config: Config, capacity: int,
+                pos_hi: jax.Array | int = 0) -> table_ops.CountTable:
+    """Tokenize one buffer with the configured backend and build its table."""
+    if config.backend == "pallas":
+        from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
+
+        stream, overlong = pallas_tok.tokenize(
+            chunk, max_token_bytes=config.pallas_max_token)
+        t = table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+        return t._replace(dropped_uniques=t.dropped_uniques + overlong,
+                          dropped_count=t.dropped_count + overlong)
+    stream = tok_ops.tokenize(chunk)
+    return table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "config"))
+def _count_step(data: jax.Array, capacity: int, config: Config) -> table_ops.CountTable:
+    return _map_stream(data, config, capacity)
 
 
 def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> table_ops.CountTable:
     """Run the device pipeline over one in-memory buffer, return the table."""
     buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
-    padded_len = max(128, -(-buf.shape[0] // 128) * 128)
+    min_len = 128 * (2 * config.pallas_max_token + 2) if config.backend == "pallas" else 128
+    padded_len = max(min_len, -(-buf.shape[0] // 128) * 128)
     padded = tok_ops.pad_to(buf, padded_len)
-    return _count_step(jax.device_put(padded), config.table_capacity)
+    return _count_step(jax.device_put(padded), config.table_capacity, config)
 
 
 def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
@@ -111,8 +126,7 @@ class WordCountJob:
         return table_ops.empty(self.capacity)
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
-        stream = tok_ops.tokenize(chunk)
-        return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
+        return _map_stream(chunk, self.config, self.batch_capacity, pos_hi=chunk_id)
 
     def combine(self, state, update):
         return table_ops.merge(state, update, capacity=self.capacity)

@@ -1,0 +1,288 @@
+"""Pallas TPU kernel: fused tokenize + rolling-hash in one HBM pass.
+
+TPU-native replacement for the map-phase device work of the reference (the
+per-thread char-copy loops of ``mapper``, ``main.cu:37-54``, plus the host
+tokenizer, ``main.cu:187-202``).  The pure-XLA formulation in
+:mod:`mapreduce_tpu.ops.tokenize` runs a segmented ``associative_scan`` —
+log-depth but several full-array materializations.  This kernel computes the
+identical per-position (key_hi, key_lo, length) outputs in a *single* pass:
+bytes stream HBM -> VMEM once, all W-byte lookback happens on-chip, and only
+the three token-end output planes go back to HBM.
+
+Layout
+------
+A flat uint8 chunk of N bytes is viewed column-major as ``(L, 128)``:
+lane j holds the contiguous byte segment ``[j*L, (j+1)*L)``, rows are byte
+positions within the segment.  A shift by one *byte* is then a shift by one
+*row* — a cheap sublane move — and the W-step lookback loop is W static row
+slices, fully vectorized over 128 lanes x block_rows sublanes.
+
+The grid walks row-blocks top to bottom.  TPU grids execute sequentially, so
+a ``(W+1, 128)`` VMEM scratch carries the previous block's tail rows: the
+lookback window never re-reads HBM.
+
+Token length is bounded by W (default 32).  Three cases leave the kernel for
+the two tiny fix-up passes the wrapper runs in XLA:
+
+* tokens touching a 128-lane *seam* (the boundary between consecutive byte
+  segments, where "previous byte" lives in another lane) are suppressed
+  in-kernel and re-tokenized from 129 seam windows of ``2W+2`` bytes each
+  (<= 9 KB total) — the chunk-seam strategy of SURVEY §7 applied at lane
+  granularity;
+* tokens longer than W bytes are dropped and *counted* (exactly once, at
+  their true end) into an overlong counter the caller folds into the count
+  table's ``dropped_*`` accounting — never silent corruption (contrast the
+  reference's unchecked buffer overflows past MAX_WORD_COUNT, ``main.cu:184``);
+* the hash recurrence, fmix32 finalization, and sentinel clamping replicate
+  :func:`mapreduce_tpu.ops.tokenize.tokenize` bit-for-bit, so tables built
+  from either backend merge interchangeably.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mapreduce_tpu import constants
+from mapreduce_tpu.ops import tokenize as tok_ops
+from mapreduce_tpu.ops.tokenize import TokenStream
+
+LANES = 128
+DEFAULT_MAX_TOKEN = 32  # W: max token bytes handled fully on the fast path
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _pow_mod32(base: np.uint32, k: int) -> np.uint32:
+    return np.uint32(pow(int(base), k, 1 << 32))
+
+
+# Bit-for-bit parity with the XLA backend is the contract; share its hashing
+# and separator definitions rather than copying them.
+_fmix32 = tok_ops._fmix32
+_sep_mask = tok_ops.separator_mask
+
+
+def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
+                     *, w: int, block_rows: int, data_rows: int):
+    """One grid step: emit (key_hi, key_lo, length) for block positions.
+
+    Output row t of block i describes byte-row ``m = i*block_rows + t - 1`` of
+    each lane (one-row offset so the next-byte separator test only ever looks
+    at rows already resident).  Non-emitting positions carry the sentinel key
+    and length 0.
+    """
+    i = pl.program_id(0)
+    tb = block_rows
+
+    @pl.when(i == 0)
+    def _():
+        # Rows "above" the first block are artificial separators: every lane
+        # top is a segment start (real continuation is the previous lane's
+        # tail, which the seam pass owns).
+        carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
+        over_ref[0, 0] = jnp.uint32(0)
+
+    ext = jnp.concatenate([carry_ref[:], x_ref[:]], axis=0)  # (w+1+tb, LANES)
+    carry_ref[:] = x_ref[tb - (w + 1):, :]
+
+    sep = _sep_mask(ext)
+    c = ext.astype(jnp.uint32) + jnp.uint32(1)
+
+    # Positions handled this step: ext rows [w, w+tb) = byte rows m below.
+    cur_sep = sep[w:w + tb]
+    nxt_sep = sep[w + 1:w + tb + 1]
+    is_end = (~cur_sep) & nxt_sep
+
+    intok = ~cur_sep
+    h1 = jnp.where(intok, c[w:w + tb], jnp.uint32(0))
+    h2 = h1
+    ln = intok.astype(jnp.uint32)
+    for k in range(1, w):
+        intok = intok & ~sep[w - k:w - k + tb]
+        ck = c[w - k:w - k + tb]
+        h1 = h1 + jnp.where(intok, ck * _pow_mod32(constants.HASH_BASE_1, k), jnp.uint32(0))
+        h2 = h2 + jnp.where(intok, ck * _pow_mod32(constants.HASH_BASE_2, k), jnp.uint32(0))
+        ln = ln + intok.astype(jnp.uint32)
+
+    # True length may exceed w: the byte w back is still inside the run.
+    run_exceeds_w = intok & ~sep[0:tb]
+
+    row_in_block = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 0)
+    m = i * tb + row_in_block - 1  # byte row within the lane's segment
+
+    # Defer to the seam pass: tokens starting at lane row 0 (previous byte is
+    # another lane's data) and tokens ending at the lane's last data row (next
+    # byte is another lane's data, so is_end itself is unreliable there).
+    starts_at_lane_top = ln.astype(jnp.int32) == m + 1
+    ends_at_lane_bottom = m == data_rows - 1
+    emit = is_end & ~run_exceeds_w & ~starts_at_lane_top & ~ends_at_lane_bottom
+
+    # Overlong runs are counted exactly once, at their true end.  Runs whose
+    # lookback crosses the lane top are counted by the seam pass instead
+    # (their suppression here shows up as starts_at_lane_top=False only when
+    # the lookback window is fully in-lane, which run_exceeds_w guarantees).
+    overlong_here = is_end & run_exceeds_w & ~ends_at_lane_bottom
+    over_ref[0, 0] = over_ref[0, 0] + jnp.sum(overlong_here.astype(jnp.uint32))
+
+    khi = _fmix32(h1 ^ ln)
+    klo = _fmix32(h2 + jnp.uint32(0x9E3779B9) * ln)
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    at_sent = (khi == sent) & (klo == sent)
+    klo = jnp.where(at_sent, klo - jnp.uint32(1), klo)
+
+    khi_ref[:] = jnp.where(emit, khi, sent)
+    klo_ref[:] = jnp.where(emit, klo, sent)
+    len_ref[:] = jnp.where(emit, ln, jnp.uint32(0))
+
+
+def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
+                 data_rows: int, interpret: bool):
+    """Run the kernel over the (rows, 128) column view (one trailing pad block)."""
+    rows = cols_padded.shape[0]
+    grid = rows // block_rows
+    kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
+                             data_rows=data_rows)
+    out32 = jax.ShapeDtypeStruct((rows, LANES), jnp.uint32)
+    khi, klo, ln, over = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_shape=[out32, out32, out32,
+                   jax.ShapeDtypeStruct((1, 1), jnp.uint32)],
+        out_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)] * 3
+        + [pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)],
+        scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.uint8)],
+        interpret=interpret,
+    )(cols_padded)
+    return khi, klo, ln, over[0, 0]
+
+
+def _seam_pass(data: jax.Array, seg_len: int, w: int,
+               base_offset: jax.Array) -> tuple[TokenStream, jax.Array]:
+    """Re-tokenize the 129 lane-seam windows with the XLA scan path.
+
+    Window j covers bytes ``[j*seg_len - w - 1, j*seg_len + w + 1)`` (out of
+    range = PAD).  It emits exactly the tokens the kernel deferred: those whose
+    span touches a seam byte (``j*seg_len - 1`` or ``j*seg_len``), provided the
+    whole token is visible in the window.  A run truncated by the window edge
+    is an overlong token; it is counted, not emitted.
+    """
+    n = data.shape[0]
+    wlen = 2 * w + 2
+    pad = jnp.full((w + 1,), constants.PAD_BYTE, dtype=jnp.uint8)
+    padded = jnp.concatenate([pad, data, pad])  # index shift: +w+1
+    starts = jnp.arange(0, n + seg_len, seg_len)  # 129 window origins j*seg_len
+    idx = starts[:, None] + jnp.arange(wlen)[None, :]  # padded[j*L - w - 1 + q]
+    windows = padded[idx]
+
+    streams = jax.vmap(tok_ops.tokenize)(windows)  # fields: (129, wlen)
+    wpos_end = jnp.arange(wlen)[None, :].astype(jnp.int32)
+    length = streams.length.astype(jnp.int32)
+    wstart = wpos_end - length + 1
+    is_tok = streams.count > 0
+
+    # Seam bytes sit at window positions w and w+1.
+    touches = (wstart <= w) & (wpos_end >= w) | (wstart <= w + 1) & (wpos_end >= w + 1)
+    complete = (wstart >= 1) & (wpos_end <= 2 * w)
+    # Enforce the same <=W contract as the in-lane kernel so whether a token
+    # is counted never depends on where the chunk layout happened to cut it.
+    emit = is_tok & touches & complete & (length <= w)
+
+    # Overlong tokens counted here, exactly once each: truncated-at-left
+    # fragments whose true end is visible (their lookback crossed the seam, so
+    # the kernel deferred them), and complete-but-longer-than-W seam tokens.
+    overlong = jnp.sum((is_tok & touches
+                        & ((wstart == 0) & (wpos_end <= 2 * w)
+                           | complete & (length > w))).astype(jnp.uint32))
+
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    global_start = (starts[:, None] - (w + 1) + wstart).astype(jnp.int32)
+    stream = TokenStream(
+        key_hi=jnp.where(emit, streams.key_hi, sent).reshape(-1),
+        key_lo=jnp.where(emit, streams.key_lo, sent).reshape(-1),
+        count=jnp.where(emit, jnp.uint32(1), jnp.uint32(0)).reshape(-1),
+        pos=jnp.where(emit, global_start.astype(jnp.uint32)
+                      + jnp.asarray(base_offset, jnp.uint32),
+                      jnp.uint32(constants.POS_INF)).reshape(-1),
+        length=jnp.where(emit, streams.length, jnp.uint32(0)).reshape(-1),
+    )
+    return stream, overlong
+
+
+def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
+             max_token_bytes: int = DEFAULT_MAX_TOKEN,
+             block_rows: int = DEFAULT_BLOCK_ROWS,
+             interpret: bool | None = None) -> tuple[TokenStream, jax.Array]:
+    """Pallas-backed tokenize: returns ``(stream, overlong_count)``.
+
+    Emits the same (key, count, pos, length) tuples per token as
+    :func:`mapreduce_tpu.ops.tokenize.tokenize` for every token of at most
+    ``max_token_bytes`` bytes; longer tokens are dropped and tallied in the
+    returned ``overlong_count`` (uint32 scalar) for the caller to fold into
+    ``CountTable.dropped_*``.  Stream entries are NOT in byte order (the
+    column view interleaves lanes); downstream aggregation sorts by key, so
+    order is irrelevant there.
+
+    Requirements: ``len(data) % 128 == 0`` and at least one full block.
+    """
+    if interpret is None:
+        # Mosaic only targets TPU; elsewhere (CPU tests, debugging) the
+        # interpreter executes the same kernel semantics.
+        interpret = jax.default_backend() != "tpu"
+    if data.dtype != jnp.uint8:
+        raise TypeError(f"pallas tokenize expects uint8, got {data.dtype}")
+    n = data.shape[0]
+    if n % LANES:
+        raise ValueError(f"input length {n} must be a multiple of {LANES}")
+    w = max_token_bytes
+    if w < 1:
+        raise ValueError(f"max_token_bytes must be >= 1, got {w}")
+    seg_len = n // LANES
+    if block_rows < w + 2:
+        raise ValueError(f"block_rows {block_rows} must be >= max_token_bytes+2")
+    if seg_len < 2 * w + 2:
+        raise ValueError(
+            f"input of {n} bytes gives lane segments of {seg_len} < 2W+2="
+            f"{2 * w + 2} bytes; seam windows would overlap (grow the chunk "
+            f"or shrink max_token_bytes)")
+
+    # Column-major view + pad rows to a whole number of blocks, plus one extra
+    # pad block so every data row gets an output (outputs trail by one row).
+    cols = data.reshape(LANES, seg_len).T
+    pad_rows = (-seg_len) % block_rows + block_rows
+    cols_padded = jnp.concatenate(
+        [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
+
+    khi, klo, ln, over_cols = _column_pass(cols_padded, w, block_rows,
+                                           data_rows=seg_len, interpret=interpret)
+
+    # Reconstruct stream fields for the column outputs.  Output row t of the
+    # (rows, 128) planes is byte row m = t - 1 of each lane; global byte
+    # offset = lane*seg_len + m, token start = end - len + 1.
+    rows = cols_padded.shape[0]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    end = lane * seg_len + (t_idx - 1)
+    has_tok = ln > 0
+    start = jnp.where(
+        has_tok,
+        (end + 1 - ln.astype(jnp.int32)).astype(jnp.uint32)
+        + jnp.asarray(base_offset, jnp.uint32),
+        jnp.uint32(constants.POS_INF))
+    col_stream = TokenStream(
+        key_hi=khi.reshape(-1), key_lo=klo.reshape(-1),
+        count=has_tok.astype(jnp.uint32).reshape(-1),
+        pos=start.reshape(-1), length=ln.reshape(-1))
+
+    seam_stream, over_seams = _seam_pass(data, seg_len, w, base_offset)
+
+    cat = lambda a, b: jnp.concatenate([a, b])
+    stream = TokenStream(*(cat(a, b) for a, b in zip(col_stream, seam_stream)))
+    return stream, over_cols + over_seams

@@ -28,12 +28,16 @@ class CheckpointMismatch(RuntimeError):
     """The checkpoint was produced by an incompatible run configuration."""
 
 
-def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int) -> dict:
+def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
+                    backend: str = "xla", pallas_max_token: int = 0) -> dict:
     """Identity of a run: resuming under a different identity is an error.
 
     The input file is fingerprinted by size + a head/tail content hash, so a
-    replaced or appended corpus is detected without rehashing 100 GB.
-    Table capacity is deliberately not part of the dict: it is validated
+    replaced or appended corpus is detected without rehashing 100 GB.  The
+    backend (and its token-length envelope) is part of the identity because
+    it changes counting semantics: the pallas backend drops >W tokens into
+    ``dropped_*``, so resuming under the other backend would mix semantics
+    mid-run.  Table capacity is deliberately not in the dict: it is validated
     against the saved arrays' actual shape (ground truth) by the executor.
     """
     size = os.path.getsize(input_path)
@@ -44,7 +48,9 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int) -> dict:
             f.seek(max(0, size - (1 << 16)))
             h.update(f.read(1 << 16))
     return {"input_size": size, "input_hash": h.hexdigest(),
-            "n_devices": n_devices, "chunk_bytes": chunk_bytes}
+            "n_devices": n_devices, "chunk_bytes": chunk_bytes,
+            "backend": backend,
+            "pallas_max_token": pallas_max_token if backend == "pallas" else 0}
 
 
 def save(path: str, state: CountTable, step: int, offset: int,

@@ -60,7 +60,9 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
 
     start_step, start_offset = 0, 0
     bases_list: list[np.ndarray] = []
-    fingerprint = ckpt_mod.run_fingerprint(path, n_dev, config.chunk_bytes) \
+    fingerprint = ckpt_mod.run_fingerprint(
+        path, n_dev, config.chunk_bytes, backend=config.backend,
+        pallas_max_token=config.pallas_max_token) \
         if checkpoint_path else None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         state_np, start_step, start_offset, bases_arr = ckpt_mod.load(

@@ -1,0 +1,40 @@
+"""Profiler hooks: XProf/Perfetto traces and named trace regions.
+
+The reference has no tracing of any kind (``time.h`` is a dead include,
+``main.cu:6``; SURVEY §5).  Here any run can capture a ``jax.profiler`` trace
+— device timelines, XLA op breakdown, HBM usage — viewable in XProf /
+Perfetto, plus cheap named host regions that show up on the same timeline.
+
+Usage::
+
+    with profiling.trace("/tmp/trace"):     # no-op when path is falsy
+        with profiling.region("step"):
+            state = engine.step(state, batch.data, batch.step)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(path: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace under ``path`` (a directory).  Falsy path
+    = no-op, so call sites can pass the flag through unconditionally."""
+    if not path:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(path):
+        yield
+
+
+@contextlib.contextmanager
+def region(name: str) -> Iterator[None]:
+    """A named region on the profiler timeline (cheap when not tracing)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,64 @@
+"""Native chunker vs. the pure-Python reader path: byte-identical batches."""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu import native
+from mapreduce_tpu.data import reader
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native chunker unavailable (no g++?)")
+    return lib
+
+
+def _write(tmp_path, data: bytes):
+    p = tmp_path / "c.txt"
+    p.write_bytes(data)
+    return str(p)
+
+
+@pytest.mark.parametrize("n_words,chunk,shards", [
+    (500, 256, 4), (3000, 512, 8), (100, 4096, 2), (1, 128, 4),
+])
+def test_batch_parity(tmp_path, rng, lib, n_words, chunk, shards):
+    corpus = make_corpus(rng, n_words, vocab=80)
+    path = _write(tmp_path, corpus)
+    nat = list(reader.iter_batches(path, shards, chunk, use_native=True))
+    py = list(reader.iter_batches(path, shards, chunk, use_native=False))
+    assert len(nat) == len(py)
+    for a, b in zip(nat, py):
+        np.testing.assert_array_equal(a.data, b.data)
+        np.testing.assert_array_equal(a.base_offsets, b.base_offsets)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        assert a.step == b.step
+
+
+def test_batch_parity_force_split(tmp_path, lib):
+    """A separator-free run longer than max_token_bytes force-splits
+    identically in both implementations."""
+    data = b"a" * 1000 + b" end\n"
+    path = _write(tmp_path, data)
+    nat = list(reader.iter_batches(path, 2, 256, max_token_bytes=64, use_native=True))
+    py = list(reader.iter_batches(path, 2, 256, max_token_bytes=64, use_native=False))
+    for a, b in zip(nat, py):
+        np.testing.assert_array_equal(a.data, b.data)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+
+
+def test_token_count(lib, rng):
+    corpus = make_corpus(rng, 2000, vocab=100)
+    buf = np.frombuffer(corpus, dtype=np.uint8)
+    assert native.token_count(buf) == oracle.total_count(corpus)
+
+
+def test_token_count_edges(lib):
+    assert native.token_count(np.frombuffer(b"", np.uint8)) == 0
+    assert native.token_count(np.frombuffer(b"   ", np.uint8)) == 0
+    assert native.token_count(np.frombuffer(b"x", np.uint8)) == 1
+    assert native.token_count(np.frombuffer(b" x y", np.uint8)) == 2

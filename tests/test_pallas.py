@@ -1,0 +1,130 @@
+"""Pallas tokenize kernel vs. the XLA scan oracle (interpret mode on CPU).
+
+SURVEY §4: kernel-level tests compare Pallas output to the pure-JAX oracle
+under ``interpret=True``.  Tables built from either backend must be
+field-for-field identical (same hashes, counts, first-occurrence positions)
+for every token within the W-byte envelope; overlong tokens must be dropped
+into exact ``dropped_*`` accounting.
+"""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.ops import table as tbl
+from mapreduce_tpu.ops import tokenize as tok
+from mapreduce_tpu.ops.pallas import tokenize as ptok
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+W = 8  # small lookback so tests exercise the overlong path cheaply
+CAP = 4096
+
+
+def _pad(data: bytes, w: int = W) -> np.ndarray:
+    n = max(128 * (2 * w + 2), -(-len(data) // 128) * 128)
+    return tok.pad_to(data, n)
+
+
+def _tables(data: bytes, w: int = W, block_rows: int = 64):
+    buf = _pad(data, w)
+    stream_x = tok.tokenize(buf)
+    want = tbl.from_stream(stream_x, CAP)
+    stream_p, overlong = ptok.tokenize(buf, max_token_bytes=w,
+                                       block_rows=block_rows, interpret=True)
+    got = tbl.from_stream(stream_p, CAP)
+    return want, got, int(overlong)
+
+
+def _assert_tables_equal(want, got):
+    for f in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)), np.asarray(getattr(got, f)), err_msg=f)
+
+
+def test_fixture_exact(fixture_text):
+    want, got, overlong = _tables(fixture_text)
+    assert overlong == 0
+    _assert_tables_equal(want, got)
+
+
+def test_random_corpus_exact(rng):
+    corpus = make_corpus(rng, n_words=3000, vocab=200)  # words well under W
+    want, got, overlong = _tables(corpus)
+    assert overlong == 0
+    _assert_tables_equal(want, got)
+
+
+def test_tokens_at_exact_w_boundary():
+    # length W is on the fast path; W+1 is overlong.
+    data = (b"x" * W + b" " + b"y" * W + b"\n") * 40
+    want, got, overlong = _tables(data)
+    assert overlong == 0
+    _assert_tables_equal(want, got)
+
+
+def test_overlong_tokens_dropped_and_counted():
+    data = b"short " * 50 + b"z" * (W + 1) + b" tail " + b"q" * (3 * W) + b"\n"
+    buf = _pad(data)
+    stream_p, overlong = ptok.tokenize(buf, max_token_bytes=W,
+                                       block_rows=64, interpret=True)
+    got = tbl.from_stream(stream_p, CAP)
+    assert int(overlong) == 2  # the two overlong runs, once each
+    # Every short token still counted exactly.
+    counts = oracle.word_counts(data)
+    short_total = sum(c for word, c in counts.items() if len(word) <= W)
+    assert int(got.total_count()) == short_total
+    assert int(got.n_valid()) == len([w for w in counts if len(w) <= W])
+
+
+def test_lane_seam_tokens(rng):
+    """Tokens placed to straddle the 128-lane segment seams exactly."""
+    w = 8
+    n = 128 * (2 * w + 2)  # minimum size: every seam is close to its neighbors
+    seg = n // 128
+    buf = np.full(n, 0x20, dtype=np.uint8)
+    # A word crossing every seam j*seg for j=1..127, plus chunk start/end.
+    for j in range(1, 128):
+        s = j * seg - 3
+        buf[s:s + 6] = np.frombuffer(b"abcdef", dtype=np.uint8)
+    buf[:4] = np.frombuffer(b"head", dtype=np.uint8)
+    buf[-4:] = np.frombuffer(b"tail", dtype=np.uint8)
+    want = tbl.from_stream(tok.tokenize(buf), CAP)
+    stream_p, overlong = ptok.tokenize(buf, max_token_bytes=w,
+                                       block_rows=32, interpret=True)
+    got = tbl.from_stream(stream_p, CAP)
+    assert int(overlong) == 0
+    _assert_tables_equal(want, got)
+
+
+def test_count_words_pallas_backend(rng):
+    corpus = make_corpus(rng, n_words=1500, vocab=120)
+    cfg = Config(chunk_bytes=128 * (2 * 32 + 2), table_capacity=CAP,
+                 backend="pallas")
+    with _interpret_mode():
+        result = wordcount.count_words(corpus, cfg)
+    assert result.as_dict() == oracle.word_counts(corpus)
+    assert result.total == oracle.total_count(corpus)
+
+
+def test_streaming_executor_pallas_backend(tmp_path, rng):
+    """The full sharded streaming path (shard_map-traced pallas_call, padded
+    rows, overlong accounting through merge) with backend='pallas'."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import executor
+
+    corpus = make_corpus(rng, n_words=4000, vocab=200)
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=128 * (2 * 32 + 2), table_capacity=CAP,
+                 backend="pallas")
+    result = executor.count_file(str(path), cfg, mesh=data_mesh(4))
+    assert result.as_dict() == oracle.word_counts(corpus)
+    assert result.total == oracle.total_count(corpus)
+
+
+def _interpret_mode():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.force_tpu_interpret_mode()
