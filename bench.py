@@ -74,19 +74,21 @@ def main() -> int:
         batches = list(reader.iter_batches(path, n_dev, cfg.chunk_bytes))
         state = engine.init_states()
         # Warm-up step: pays XLA compile; excluded from steady-state timing.
+        # A host fetch is the only reliable sync point (block_until_ready is
+        # not a real barrier under remote-device tunnels).
         state = engine.step(state, batches[0].data, 0)
-        jax.block_until_ready(state)
+        np.asarray(state.dropped_count)
         t0 = time.perf_counter()
         done = int(batches[0].lengths.sum())
         for b in batches[1:]:
             state = engine.step(state, b.data, b.step)
             done += int(b.lengths.sum())
         table = engine.finish(state)
-        jax.block_until_ready(table)
+        np.asarray(table.dropped_count)  # barrier: fetch an existing leaf
         dt = time.perf_counter() - t0
+        total_words = int(np.asarray(table.total_count()))
         steady_bytes = done - int(batches[0].lengths.sum())
         gbps = steady_bytes / 1e9 / dt
-        total_words = int(np.asarray(table.total_count()))
         words_per_s = total_words * (steady_bytes / len(corpus)) / dt
     finally:
         os.unlink(path)

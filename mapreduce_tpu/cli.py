@@ -47,8 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: checkpoint state to PATH and resume from it")
     p.add_argument("--checkpoint-every", type=int, default=25, metavar="STEPS")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
-    p.add_argument("--backend", choices=("xla", "pallas"), default="xla",
-                   help="map-phase implementation (pallas = fused TPU kernel)")
+    p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
+                   help="map-phase implementation (auto = pallas fused kernel "
+                        "on TPU, xla scan elsewhere)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
     return p

@@ -29,9 +29,14 @@ class Config:
         N bytes has at most ceil(N/2) tokens.
       mesh_axis: name of the data-parallel mesh axis.
       backend: map-phase implementation — 'xla' (segmented associative scan,
-        any token length) or 'pallas' (fused single-pass TPU kernel; tokens
+        any token length), 'pallas' (fused single-pass TPU kernel; tokens
         longer than ``pallas_max_token`` bytes are dropped into ``dropped_*``
-        accounting rather than counted).
+        accounting rather than counted), or 'auto' (the default: pallas on
+        TPU when the chunk is large enough for its seam windows, xla
+        elsewhere).  'auto' exists because the associative-scan formulation,
+        while fine on CPU and for small shapes, compiles pathologically
+        slowly on real TPU at multi-MB chunk sizes — the fused kernel is the
+        TPU path.
       pallas_max_token: W for the pallas backend's on-chip lookback window.
     """
 
@@ -39,7 +44,7 @@ class Config:
     table_capacity: int = 1 << 18
     batch_unique_capacity: Optional[int] = None
     mesh_axis: str = "data"
-    backend: str = "xla"
+    backend: str = "auto"
     pallas_max_token: int = 32
 
     def __post_init__(self) -> None:
@@ -47,18 +52,39 @@ class Config:
             raise ValueError(f"chunk_bytes must be a multiple of 128, got {self.chunk_bytes}")
         if self.table_capacity < 2:
             raise ValueError("table_capacity must be >= 2")
-        if self.backend not in ("xla", "pallas"):
+        if self.backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.backend == "pallas":
-            if self.pallas_max_token < 1:
-                raise ValueError(
-                    f"pallas_max_token must be >= 1, got {self.pallas_max_token}")
+        if self.backend != "xla" and self.pallas_max_token < 1:
+            # 'auto' may resolve to pallas at runtime; fail at construction,
+            # not mid-trace inside the kernel.
+            raise ValueError(
+                f"pallas_max_token must be >= 1, got {self.pallas_max_token}")
+        if self.backend == "pallas" and self.chunk_bytes < self.pallas_min_chunk:
             # Seam windows must not overlap: lane segment >= 2W+2 bytes.
-            min_chunk = 128 * (2 * self.pallas_max_token + 2)
-            if self.chunk_bytes < min_chunk:
-                raise ValueError(
-                    f"pallas backend needs chunk_bytes >= {min_chunk} "
-                    f"for pallas_max_token={self.pallas_max_token}")
+            # ('auto' instead falls back to xla for chunks this small.)
+            raise ValueError(
+                f"pallas backend needs chunk_bytes >= {self.pallas_min_chunk} "
+                f"for pallas_max_token={self.pallas_max_token}")
+
+    @property
+    def pallas_min_chunk(self) -> int:
+        """Smallest chunk the pallas kernel accepts (non-overlapping seam
+        windows need lane segments of >= 2W+2 bytes)."""
+        return 128 * (2 * self.pallas_max_token + 2)
+
+    def resolved_backend(self) -> str:
+        """Resolve 'auto' against the runtime platform.
+
+        Deterministic for a given process (jax.default_backend() is fixed
+        once initialized), so jit caches keyed on the Config stay coherent.
+        """
+        if self.backend != "auto":
+            return self.backend
+        import jax
+
+        if jax.default_backend() == "tpu" and self.chunk_bytes >= self.pallas_min_chunk:
+            return "pallas"
+        return "xla"
 
     @property
     def batch_uniques(self) -> int:

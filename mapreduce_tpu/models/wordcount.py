@@ -31,10 +31,14 @@ class WordCountResult:
 
     words: list[bytes]  # reported words, by first occurrence
     counts: list[int]  # parallel to words
-    total: int  # total tokens (includes any spilled ones)
-    distinct: int  # distinct words seen (reported + spilled), top-k invariant
-    dropped_uniques: int  # diagnostic: distinct words spilled past capacity
-    dropped_count: int  # tokens belonging to spilled words
+    total: int  # total tokens (includes any spilled/dropped ones; exact)
+    distinct: int  # distinct words: exact when dropped_uniques == 0, else an
+    #   upper bound (len(words) + dropped_uniques)
+    dropped_uniques: int  # upper bound on distinct words spilled past table
+    #   capacity or dropped as overlong; loose because cross-chunk merges sum
+    #   per-chunk bounds and the pallas backend cannot hash (hence cannot
+    #   dedupe) tokens longer than its lookback window
+    dropped_count: int  # tokens belonging to spilled/dropped words (exact)
 
     def as_dict(self) -> dict[bytes, int]:
         return dict(zip(self.words, self.counts))
@@ -57,12 +61,16 @@ def apply_top_k(result: WordCountResult, k: int) -> WordCountResult:
 def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 pos_hi: jax.Array | int = 0) -> table_ops.CountTable:
     """Tokenize one buffer with the configured backend and build its table."""
-    if config.backend == "pallas":
+    if config.resolved_backend() == "pallas":
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
         stream, overlong = pallas_tok.tokenize(
             chunk, max_token_bytes=config.pallas_max_token)
         t = table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+        # ``overlong`` counts occurrences.  For dropped_count (occurrences)
+        # that is exact; for dropped_uniques it is the only available upper
+        # bound — overlong tokens leave the kernel unhashed, so distinct
+        # overlong words cannot be deduplicated on device.
         return t._replace(dropped_uniques=t.dropped_uniques + overlong,
                           dropped_count=t.dropped_count + overlong)
     stream = tok_ops.tokenize(chunk)
@@ -77,7 +85,7 @@ def _count_step(data: jax.Array, capacity: int, config: Config) -> table_ops.Cou
 def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> table_ops.CountTable:
     """Run the device pipeline over one in-memory buffer, return the table."""
     buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
-    min_len = 128 * (2 * config.pallas_max_token + 2) if config.backend == "pallas" else 128
+    min_len = config.pallas_min_chunk if config.resolved_backend() == "pallas" else 128
     padded_len = max(min_len, -(-buf.shape[0] // 128) * 128)
     padded = tok_ops.pad_to(buf, padded_len)
     return _count_step(jax.device_put(padded), config.table_capacity, config)

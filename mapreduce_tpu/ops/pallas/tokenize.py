@@ -62,9 +62,23 @@ def _pow_mod32(base: np.uint32, k: int) -> np.uint32:
 
 
 # Bit-for-bit parity with the XLA backend is the contract; share its hashing
-# and separator definitions rather than copying them.
+# definition rather than copying it.
 _fmix32 = tok_ops._fmix32
-_sep_mask = tok_ops.separator_mask
+
+
+def _sep_mask_i32(x: jax.Array) -> jax.Array:
+    """Separator test on int32-widened bytes.
+
+    Mosaic (v5e) cannot lower 8-bit vector comparisons ("Target does not
+    support this comparison"), so the kernel widens bytes to int32 at load
+    and classifies there.  Derived from ``constants.SEPARATOR_BYTES`` — the
+    same source of truth as :func:`...ops.tokenize.separator_mask` — so the
+    backends can never drift apart.
+    """
+    sep = x == constants.SEPARATOR_BYTES[0]
+    for b in constants.SEPARATOR_BYTES[1:]:
+        sep = sep | (x == b)
+    return sep
 
 
 def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
@@ -87,11 +101,14 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
         carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
         over_ref[0, 0] = jnp.uint32(0)
 
-    ext = jnp.concatenate([carry_ref[:], x_ref[:]], axis=0)  # (w+1+tb, LANES)
-    carry_ref[:] = x_ref[tb - (w + 1):, :]
+    # Widen bytes to int32 immediately: v5e Mosaic has no 8-bit vector
+    # compares, and 32-bit lanes are the VPU-native layout anyway.
+    x = x_ref[:].astype(jnp.int32)
+    ext = jnp.concatenate([carry_ref[:], x], axis=0)  # (w+1+tb, LANES) int32
+    carry_ref[:] = x[tb - (w + 1):, :]
 
-    sep = _sep_mask(ext)
-    c = ext.astype(jnp.uint32) + jnp.uint32(1)
+    sep = _sep_mask_i32(ext)
+    c = (ext + 1).astype(jnp.uint32)
 
     # Positions handled this step: ext rows [w, w+tb) = byte rows m below.
     cur_sep = sep[w:w + tb]
@@ -127,7 +144,9 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
     # (their suppression here shows up as starts_at_lane_top=False only when
     # the lookback window is fully in-lane, which run_exceeds_w guarantees).
     overlong_here = is_end & run_exceeds_w & ~ends_at_lane_bottom
-    over_ref[0, 0] = over_ref[0, 0] + jnp.sum(overlong_here.astype(jnp.uint32))
+    # Mosaic cannot lower reductions over unsigned ints; sum in int32.
+    n_overlong = jnp.sum(overlong_here.astype(jnp.int32)).astype(jnp.uint32)
+    over_ref[0, 0] = over_ref[0, 0] + n_overlong
 
     khi = _fmix32(h1 ^ ln)
     klo = _fmix32(h2 + jnp.uint32(0x9E3779B9) * ln)
@@ -158,7 +177,7 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         out_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)] * 3
         + [pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)],
-        scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.uint8)],
+        scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
         interpret=interpret,
     )(cols_padded)
     return khi, klo, ln, over[0, 0]

@@ -53,6 +53,11 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
             "pallas_max_token": pallas_max_token if backend == "pallas" else 0}
 
 
+# Values assumed for fingerprint keys absent from an older checkpoint's meta
+# (i.e. the only behavior that existed before the key was introduced).
+_FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0}
+
+
 def save(path: str, state: CountTable, step: int, offset: int,
          bases: np.ndarray, fingerprint: dict | None = None) -> None:
     """Atomically persist a run snapshot.
@@ -95,7 +100,10 @@ def load(path: str, expect_fingerprint: dict | None = None
         meta = json.loads(bytes(z["__meta"]).decode() or "{}") if "__meta" in z else {}
         if expect_fingerprint:
             for key, want in expect_fingerprint.items():
-                got = meta.get(key)
+                # Checkpoints written before a key joined the fingerprint get
+                # that key's historical default (there was only one behavior
+                # then), so upgrading mid-run never forces a restart.
+                got = meta.get(key, _FINGERPRINT_DEFAULTS.get(key))
                 if got != want:
                     raise CheckpointMismatch(
                         f"checkpoint {path} was written with {key}={got!r}, "

@@ -61,7 +61,7 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     start_step, start_offset = 0, 0
     bases_list: list[np.ndarray] = []
     fingerprint = ckpt_mod.run_fingerprint(
-        path, n_dev, config.chunk_bytes, backend=config.backend,
+        path, n_dev, config.chunk_bytes, backend=config.resolved_backend(),
         pallas_max_token=config.pallas_max_token) \
         if checkpoint_path else None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):

@@ -1,0 +1,62 @@
+"""Config validation and 'auto' backend resolution.
+
+The CPU-pinned suite (conftest forces JAX_PLATFORMS=cpu) never sees a real
+TPU, so the TPU branches of ``resolved_backend`` are exercised here by
+monkeypatching ``jax.default_backend`` — the resolution logic is pure given
+(platform, chunk_bytes).
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from mapreduce_tpu.config import Config
+
+
+def test_default_backend_is_auto():
+    assert Config().backend == "auto"
+
+
+def test_auto_resolves_to_xla_off_tpu():
+    assert jax.default_backend() != "tpu"  # conftest pins CPU
+    assert Config().resolved_backend() == "xla"
+
+
+def test_auto_resolves_to_pallas_on_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = Config(chunk_bytes=1 << 20)
+    assert cfg.chunk_bytes >= cfg.pallas_min_chunk
+    assert cfg.resolved_backend() == "pallas"
+
+
+def test_auto_falls_back_to_xla_for_small_chunks_on_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = Config(chunk_bytes=1 << 10)  # below pallas_min_chunk (8448 @ W=32)
+    assert cfg.chunk_bytes < cfg.pallas_min_chunk
+    assert cfg.resolved_backend() == "xla"
+
+
+def test_explicit_backends_resolve_to_themselves(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert Config(backend="xla").resolved_backend() == "xla"
+    assert Config(backend="pallas").resolved_backend() == "pallas"
+
+
+def test_pallas_max_token_validated_for_auto_and_pallas():
+    with pytest.raises(ValueError, match="pallas_max_token"):
+        Config(backend="auto", pallas_max_token=0)
+    with pytest.raises(ValueError, match="pallas_max_token"):
+        Config(backend="pallas", pallas_max_token=0)
+    Config(backend="xla", pallas_max_token=0)  # xla never consults it
+
+
+def test_pallas_chunk_floor_enforced_only_for_explicit_pallas():
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        Config(backend="pallas", chunk_bytes=1 << 10)
+    Config(backend="auto", chunk_bytes=1 << 10)  # auto falls back instead
+
+
+def test_chunk_bytes_alignment():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        Config(chunk_bytes=1000)
