@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="with --stream: checkpoint state to PATH and resume from it")
     p.add_argument("--checkpoint-every", type=int, default=25, metavar="STEPS")
+    p.add_argument("--superstep", type=int, default=1, metavar="K",
+                   help="with --stream: fold K chunks into one dispatch "
+                        "(lax.scan) to amortize per-dispatch overhead")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
@@ -95,7 +98,7 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
-                        backend=args.backend)
+                        backend=args.backend, superstep=args.superstep)
     except ValueError as e:
         parser.error(str(e))
 

@@ -38,6 +38,10 @@ class Config:
         slowly on real TPU at multi-MB chunk sizes — the fused kernel is the
         TPU path.
       pallas_max_token: W for the pallas backend's on-chip lookback window.
+      superstep: chunks folded into ONE dispatch per device via ``lax.scan``
+        (Engine.step_many).  >1 amortizes per-dispatch overhead — decisive on
+        high-latency device links — at the cost of staging superstep *
+        chunk_bytes input per device per dispatch.
     """
 
     chunk_bytes: int = 1 << 20
@@ -46,6 +50,7 @@ class Config:
     mesh_axis: str = "data"
     backend: str = "auto"
     pallas_max_token: int = 32
+    superstep: int = 1
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
@@ -54,6 +59,8 @@ class Config:
             raise ValueError("table_capacity must be >= 2")
         if self.backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.superstep < 1:
+            raise ValueError(f"superstep must be >= 1, got {self.superstep}")
         if self.backend != "xla" and self.pallas_max_token < 1:
             # 'auto' may resolve to pallas at runtime; fail at construction,
             # not mid-trace inside the kernel.

@@ -66,7 +66,10 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
 
         stream, overlong = pallas_tok.tokenize(
             chunk, max_token_bytes=config.pallas_max_token)
-        t = table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+        t = table_ops.from_stream(
+            stream, capacity, pos_hi=pos_hi,
+            max_token_bytes=config.pallas_max_token,
+            max_pos=int(chunk.shape[0]))
         # ``overlong`` counts occurrences.  For dropped_count (occurrences)
         # that is exact; for dropped_uniques it is the only available upper
         # bound — overlong tokens leave the kernel unhashed, so distinct

@@ -282,12 +282,26 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
     khi, klo, ln, over_cols = _column_pass(cols_padded, w, block_rows,
                                            data_rows=seg_len, interpret=interpret)
 
-    # Reconstruct stream fields for the column outputs.  Output row t of the
-    # (rows, 128) planes is byte row m = t - 1 of each lane; global byte
-    # offset = lane*seg_len + m, token start = end - len + 1.
+    # Pairwise compaction: a token end at byte row m requires byte m+1 to be
+    # a separator, so two consecutive byte rows of one lane can never both
+    # end tokens.  Each (2r, 2r+1) output-row pair therefore holds at most
+    # one emission — select it and halve every plane before leaving the
+    # (rows, 128) layout.  Pure elementwise work, and it halves the input to
+    # the downstream sort-based aggregation (the actual hot spot).
     rows = cols_padded.shape[0]
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    sel = ln[0::2] > 0
+    khi = jnp.where(sel, khi[0::2], khi[1::2])
+    klo = jnp.where(sel, klo[0::2], klo[1::2])
+    ln = jnp.where(sel, ln[0::2], ln[1::2])
+
+    # Reconstruct stream fields.  Output row t of the (rows, 128) planes is
+    # byte row m = t - 1 of each lane; global byte offset = lane*seg_len + m,
+    # token start = end - len + 1.  After halving, t = 2r (+1 if the odd row
+    # was selected).
+    half = rows // 2
+    t_idx = 2 * jax.lax.broadcasted_iota(jnp.int32, (half, LANES), 0) \
+        + jnp.where(sel, 0, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (half, LANES), 1)
     end = lane * seg_len + (t_idx - 1)
     has_tok = ln > 0
     start = jnp.where(

@@ -134,12 +134,88 @@ def _build(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int,
     )
 
 
-def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0) -> CountTable:
+def _from_stream_packed(stream: TokenStream, capacity: int,
+                        pos_hi: jax.Array | int) -> CountTable:
+    """Aggregation tuned for the measured TPU cost model.
+
+    On a real chip, large (multi-million element) scatters and gathers cost
+    300-900 ms while sorts cost ~3 ms/M/array and sized-``capacity`` gathers
+    are ~free.  So instead of the generic 6-array 4-key sort plus five
+    full-length segment scatters (:func:`_build`), this path:
+
+      1. packs (pos, length) into one uint32 (``pos<<6 | len``) — legal
+         because the caller guarantees len <= 63 and pos < 2**26;
+      2. sorts just 3 arrays with 3 keys — (key_hi, key_lo, packed), so the
+         smallest pos (first occurrence) leads each key's segment;
+      3. segment-reduces with *no* full-length scatters: segment ranks from a
+         cumsum, one ``searchsorted`` of arange(capacity+1) against the rank
+         array (binary search = log-n capacity-sized gathers), counts as
+         rank-range differences, and per-key fields as capacity-sized gathers
+         at the segment heads.
+
+    Matches :func:`_build` output bit-for-bit under its preconditions (all
+    counts in the stream are 0/1, one shared pos_hi).
+    """
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    inf = jnp.uint32(constants.POS_INF)
+    n = stream.key_hi.shape[0]
+    is_tok = stream.count > 0
+    packed = jnp.where(is_tok, (stream.pos << 6) | stream.length, jnp.uint32(0xFFFFFFFF))
+
+    key_hi, key_lo, packed = jax.lax.sort(
+        (stream.key_hi, stream.key_lo, packed), num_keys=3)
+
+    prev_hi = jnp.concatenate([key_hi[:1], key_hi[:-1]])
+    prev_lo = jnp.concatenate([key_lo[:1], key_lo[:-1]])
+    boundary = (key_hi != prev_hi) | (key_lo != prev_lo)
+    boundary = boundary.at[0].set(True)
+    rank = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # sorted, int32[n]
+
+    # Segment j occupies rows [head[j], head[j+1]) in sorted order.
+    head = jnp.searchsorted(rank, jnp.arange(capacity + 1, dtype=jnp.int32))
+    fi = jnp.minimum(head[:capacity], n - 1)
+    count_u = (head[1:] - head[:capacity]).astype(jnp.uint32)
+
+    key_hi_u, key_lo_u, packed_u = key_hi[fi], key_lo[fi], packed[fi]
+    occupied = (head[:capacity] < n) & ((key_hi_u != sent) | (key_lo_u != sent)) \
+        & (count_u > 0)
+
+    count_u = jnp.where(occupied, count_u, jnp.uint32(0))
+    key_hi_u = jnp.where(occupied, key_hi_u, sent)
+    key_lo_u = jnp.where(occupied, key_lo_u, sent)
+    pos_lo_u = jnp.where(occupied, packed_u >> 6, inf)
+    len_u = jnp.where(occupied, packed_u & jnp.uint32(63), jnp.uint32(0))
+    pos_hi_u = jnp.where(occupied, jnp.asarray(pos_hi, jnp.uint32), inf)
+
+    has_sentinel = (key_hi[-1] == sent) & (key_lo[-1] == sent)
+    n_real = (rank[-1] + 1).astype(jnp.uint32) - has_sentinel.astype(jnp.uint32)
+    cap = jnp.uint32(capacity)
+    dropped_uniques = jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
+    dropped_count = jnp.sum(stream.count) - jnp.sum(count_u)
+    return CountTable(
+        key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,
+        pos_hi=pos_hi_u, pos_lo=pos_lo_u, length=len_u,
+        dropped_uniques=dropped_uniques, dropped_count=dropped_count,
+    )
+
+
+def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
+                max_token_bytes: int | None = None,
+                max_pos: int | None = None) -> CountTable:
     """Aggregate a per-byte :class:`TokenStream` into a fresh table.
 
     ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
     device_index``) so first-occurrence order is globally meaningful.
+
+    ``max_token_bytes`` / ``max_pos`` are optional static bounds on the
+    stream's length and pos fields.  When both fit a packed uint32
+    (len <= 63, pos < 2**26 — true for the pallas backend's bounded-W
+    streams over chunks <= 64 MB), a sort-lean fast path runs instead of
+    the generic build; results are identical.
     """
+    if (max_token_bytes is not None and max_token_bytes <= 63
+            and max_pos is not None and max_pos <= (1 << 26)):
+        return _from_stream_packed(stream, capacity, pos_hi)
     n = stream.key_hi.shape[0]
     ph = jnp.full((n,), jnp.asarray(pos_hi, dtype=jnp.uint32))
     ph = jnp.where(stream.count > 0, ph, jnp.uint32(constants.POS_INF))

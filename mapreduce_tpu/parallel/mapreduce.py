@@ -88,7 +88,14 @@ class Engine:
         self._sharded = mesh_mod.sharded(mesh, axis)
         self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
+        self._step_many_fns: dict[int, Any] = {}
         self._finish_fn = None
+
+    @property
+    def sharding(self):
+        """The NamedSharding for per-device inputs/states (public: callers
+        staging inputs ahead of step/step_many should place them with this)."""
+        return self._sharded
 
     # -- state ---------------------------------------------------------------
 
@@ -120,6 +127,31 @@ class Engine:
         )
         return jax.jit(fn, donate_argnums=(0,))
 
+    def _build_step_many(self, k: int):
+        axis, job, n = self.axis, self.job, self.n_devices
+
+        def local_many(state, chunks, step0):
+            local = jax.tree.map(lambda x: x[0], state)
+            my = chunks[0]  # (k, chunk_bytes) after shard_map
+            dev = jax.lax.axis_index(axis).astype(jnp.uint32)
+
+            def body(st, xs):
+                chunk, j = xs
+                chunk_id = (step0 + j) * jnp.uint32(n) + dev
+                return job.combine(st, job.map_chunk(chunk, chunk_id)), None
+
+            new, _ = jax.lax.scan(
+                body, local, (my, jnp.arange(k, dtype=jnp.uint32)))
+            return jax.tree.map(lambda x: x[None], new)
+
+        fn = shard_map(
+            local_many, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(0,))
+
     def _build_finish(self):
         axis, job = self.axis, self.job
 
@@ -143,6 +175,21 @@ class Engine:
             self._step_fn = self._build_step()
         chunks = jax.device_put(chunks, self._sharded)
         return self._step_fn(state, chunks, jnp.uint32(step_index))
+
+    def step_many(self, state: Any, chunks: jax.Array, step_index: int) -> Any:
+        """K map+combine steps in ONE dispatch via ``lax.scan``.
+
+        ``chunks``: uint8[n_devices, K, chunk_bytes].  Equivalent to K calls
+        of :meth:`step` with step indices ``step_index .. step_index+K-1``
+        (chunk_ids match exactly), but amortizes per-dispatch overhead —
+        which dominates under high-latency device links — over K steps.
+        Compiles once per distinct K.
+        """
+        k = chunks.shape[1]
+        if k not in self._step_many_fns:
+            self._step_many_fns[k] = self._build_step_many(k)
+        chunks = jax.device_put(chunks, self._sharded)
+        return self._step_many_fns[k](state, chunks, jnp.uint32(step_index))
 
     def finish(self, state: Any) -> Any:
         """Collective global merge + finalize.  Result is replicated."""

@@ -83,25 +83,36 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
 
     bytes_done = int(start_offset)
     step_index = start_step
-    timer.start("stream")
-    for batch in reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
-                                         start_offset=start_offset,
-                                         start_step=start_step):
+    last_ckpt = start_step // checkpoint_every if checkpoint_every else 0
+    k = config.superstep
+    pending: list = []
+
+    def flush(state, group):
+        """Dispatch a group of consecutive batches (one superstep, or a
+        single step for a remainder group)."""
+        nonlocal bytes_done, step_index, last_ckpt
         try:
-            state = engine.step(state, batch.data, batch.step)
+            if len(group) == 1:
+                state = engine.step(state, group[0].data, group[0].step)
+            else:
+                stacked = np.stack([b.data for b in group], axis=1)
+                state = engine.step_many(state, stacked, group[0].step)
         except Exception:
             # Failure detection (SURVEY §5): device state is donated, so a
             # failed step cannot be replayed in-process.  Surface loudly with
             # the resume cursor; checkpoint/resume is the recovery path.
-            log_event(logger, "step failed", step=batch.step, offset=bytes_done,
+            log_event(logger, "step failed", step=group[0].step, offset=bytes_done,
                       resume_hint=checkpoint_path or "enable checkpointing to resume")
             raise
-        bases_list.append(batch.base_offsets)
-        bytes_done += int(batch.lengths.sum())
-        step_index = batch.step + 1
-        if progress_every and step_index % progress_every == 0:
+        for b in group:
+            bases_list.append(b.base_offsets)
+            bytes_done += int(b.lengths.sum())
+        step_index = group[-1].step + 1
+        if progress_every and step_index % progress_every < len(group):
             log_event(logger, "progress", step=step_index, bytes=bytes_done)
-        if checkpoint_every and checkpoint_path and step_index % checkpoint_every == 0:
+        if (checkpoint_every and checkpoint_path
+                and step_index // checkpoint_every > last_ckpt):
+            last_ckpt = step_index // checkpoint_every
             # Synchronize, then snapshot the state and ingest cursor.
             state_host = jax.tree.map(np.asarray, state)
             if isinstance(state_host, table_ops.CountTable):
@@ -111,6 +122,18 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
                 log_event(logger, "checkpoint", step=step_index, path=checkpoint_path)
             else:
                 log_event(logger, "checkpoint skipped: state is not a CountTable")
+        return state
+
+    timer.start("stream")
+    for batch in reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
+                                         start_offset=start_offset,
+                                         start_step=start_step):
+        pending.append(batch)
+        if len(pending) == k:
+            state = flush(state, pending)
+            pending = []
+    for batch in pending:  # remainder: single steps (no extra jit cache keys)
+        state = flush(state, [batch])
     timer.stop("stream")
 
     timer.start("reduce")

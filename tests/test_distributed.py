@@ -100,3 +100,46 @@ def test_psum_collective(mesh8):
     fn = shard_map(f, mesh=mesh8, in_specs=(P("data"),), out_specs=P())
     out = jax.jit(fn)(np.arange(64, dtype=np.int32))
     assert int(out) == 64 * 63 // 2
+
+
+def test_step_many_equals_repeated_steps(mesh8, rng):
+    """One superstep dispatch (lax.scan over K chunks) must produce exactly
+    the same state as K individual steps, chunk_ids included."""
+    corpus = make_corpus(rng, n_words=6000, vocab=250)
+    batches = [b.data for b in _batches(corpus, 8, CFG.chunk_bytes)]
+    k = len(batches)
+    assert k >= 2
+
+    eng_a = Engine(WordCountJob(CFG), mesh8)
+    state_a = eng_a.init_states()
+    for i, b in enumerate(batches):
+        state_a = eng_a.step(state_a, b, i)
+    final_a = eng_a.finish(state_a)
+
+    eng_b = Engine(WordCountJob(CFG), mesh8)
+    state_b = eng_b.init_states()
+    stacked = np.stack(batches, axis=1)  # [D, K, C]
+    state_b = eng_b.step_many(state_b, stacked, 0)
+    final_b = eng_b.finish(state_b)
+
+    for fa, fb in zip(final_a, final_b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_step_many_mixed_with_single_steps(mesh8, rng):
+    """step_many must compose with step() (remainder batches) seamlessly."""
+    corpus = make_corpus(rng, n_words=6000, vocab=250)
+    batches = [b.data for b in _batches(corpus, 8, CFG.chunk_bytes)]
+    assert len(batches) >= 3
+    head, tail = batches[:2], batches[2:]
+
+    eng = Engine(WordCountJob(CFG), mesh8)
+    state = eng.init_states()
+    state = eng.step_many(state, np.stack(head, axis=1), 0)
+    for j, b in enumerate(tail):
+        state = eng.step(state, b, len(head) + j)
+    result = eng.finish(state)
+
+    expected = oracle.word_counts(corpus)
+    assert sorted(_table_dict(result).values()) == sorted(expected.values())
+    assert int(result.total_count()) == oracle.total_count(corpus)

@@ -147,3 +147,18 @@ def test_checkpoint_roundtrip(tmp_path):
     for f in t._fields:
         np.testing.assert_array_equal(np.asarray(getattr(stacked, f)),
                                       np.asarray(getattr(s2, f)))
+
+
+def test_stream_superstep_matches_single_step(tmp_path, rng):
+    """config.superstep>1 (scan-fused dispatches + remainder single steps)
+    must produce the identical result and checkpoint-compatible bases."""
+    from tests.conftest import make_corpus
+
+    corpus = make_corpus(rng, n_words=4000, vocab=200)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    base = dict(table_capacity=1 << 10, chunk_bytes=512)
+    r1 = executor.count_file(str(path), config=Config(**base))
+    r3 = executor.count_file(str(path), config=Config(**base, superstep=3))
+    assert r1.as_dict() == r3.as_dict()
+    assert r1.words == r3.words and r1.total == r3.total

@@ -148,3 +148,19 @@ def test_top_k_preserves_totals(small_corpus):
 def test_counts_dtype_uint32(small_corpus):
     t = tbl.from_stream(_stream(small_corpus), 256)
     assert t.count.dtype == jnp.uint32
+
+
+def test_packed_fast_path_matches_build_with_overflow():
+    """_from_stream_packed must equal the generic _build bit-for-bit,
+    including the capacity-overflow branch (dropped_* accounting)."""
+    data = (" ".join(f"u{i}" for i in range(100)) + " " +
+            " ".join(f"u{i}" for i in range(0, 100, 2))).encode()
+    stream = _stream(data)
+    for cap in (32, 64, 256):  # overflow, overflow, headroom
+        slow = tbl.from_stream(stream, cap)
+        fast = tbl.from_stream(stream, cap, max_token_bytes=32,
+                               max_pos=len(data))
+        for field, a, b in zip(slow._fields, slow, fast):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"{field} cap={cap}")
+        assert int(fast.total_count()) == 150
