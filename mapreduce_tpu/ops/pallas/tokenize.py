@@ -266,6 +266,10 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
     seg_len = n // LANES
     if block_rows < w + 2:
         raise ValueError(f"block_rows {block_rows} must be >= max_token_bytes+2")
+    if block_rows % 2:
+        # Pairwise compaction halves the output rows; rows are a multiple of
+        # block_rows, so the block count must keep them even.
+        raise ValueError(f"block_rows must be even, got {block_rows}")
     if seg_len < 2 * w + 2:
         raise ValueError(
             f"input of {n} bytes gives lane segments of {seg_len} < 2W+2="

@@ -79,14 +79,30 @@ def empty(capacity: int) -> CountTable:
     return CountTable(sent, jnp.array(sent), zero, inf, jnp.array(inf), jnp.array(zero), s0, jnp.uint32(0))
 
 
+def _segment_boundaries(key_hi, key_lo):
+    """Boundary mask + segment ranks of key-sorted rows (shared by the
+    generic and packed reduce paths so their grouping can never diverge)."""
+    boundary = (key_hi != jnp.concatenate([key_hi[:1], key_hi[:-1]])) | \
+               (key_lo != jnp.concatenate([key_lo[:1], key_lo[:-1]]))
+    boundary = boundary.at[0].set(True)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # int32[n], sorted
+    return boundary, seg
+
+
+def _overflow_accounting(sorted_key_hi, sorted_key_lo, seg, capacity: int):
+    """dropped_uniques for segments past capacity.  The sentinel segment (if
+    any) sorts last — real keys are clamped below the all-ones sentinel — so
+    it is excluded by construction."""
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    has_sentinel = (sorted_key_hi[-1] == sent) & (sorted_key_lo[-1] == sent)
+    n_real = (seg[-1] + 1).astype(jnp.uint32) - has_sentinel.astype(jnp.uint32)
+    cap = jnp.uint32(capacity)
+    return jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
+
+
 def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int):
     """Group-by-key segment reduce of rows already sorted by (key, pos)."""
-    n = key_hi.shape[0]
-    prev_hi = jnp.concatenate([key_hi[:1], key_hi[:-1]])
-    prev_lo = jnp.concatenate([key_lo[:1], key_lo[:-1]])
-    boundary = (key_hi != prev_hi) | (key_lo != prev_lo)
-    boundary = boundary.at[0].set(True)
-    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # int32[n]
+    boundary, seg = _segment_boundaries(key_hi, key_lo)
 
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
@@ -107,13 +123,7 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity:
     pos_lo_u = jnp.where(occupied, pos_lo_u, inf)
     len_u = jnp.where(occupied, len_u, jnp.uint32(0))
 
-    # Overflow accounting.  The sentinel rows (empty slots / non-token
-    # positions) always form the final segment when present.
-    has_sentinel = (key_hi[-1] == sent) & (key_lo[-1] == sent)
-    n_segments = (seg[-1] + 1).astype(jnp.uint32)
-    n_real = n_segments - has_sentinel.astype(jnp.uint32)
-    cap = jnp.uint32(capacity)
-    dropped_uniques = jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
+    dropped_uniques = _overflow_accounting(key_hi, key_lo, seg, capacity)
     dropped_count = jnp.sum(count) - jnp.sum(count_u)
     return (key_hi_u, key_lo_u, count_u, pos_hi_u, pos_lo_u, len_u, dropped_uniques, dropped_count)
 
@@ -164,12 +174,7 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
 
     key_hi, key_lo, packed = jax.lax.sort(
         (stream.key_hi, stream.key_lo, packed), num_keys=3)
-
-    prev_hi = jnp.concatenate([key_hi[:1], key_hi[:-1]])
-    prev_lo = jnp.concatenate([key_lo[:1], key_lo[:-1]])
-    boundary = (key_hi != prev_hi) | (key_lo != prev_lo)
-    boundary = boundary.at[0].set(True)
-    rank = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # sorted, int32[n]
+    _, rank = _segment_boundaries(key_hi, key_lo)
 
     # Segment j occupies rows [head[j], head[j+1]) in sorted order.
     head = jnp.searchsorted(rank, jnp.arange(capacity + 1, dtype=jnp.int32))
@@ -187,10 +192,7 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     len_u = jnp.where(occupied, packed_u & jnp.uint32(63), jnp.uint32(0))
     pos_hi_u = jnp.where(occupied, jnp.asarray(pos_hi, jnp.uint32), inf)
 
-    has_sentinel = (key_hi[-1] == sent) & (key_lo[-1] == sent)
-    n_real = (rank[-1] + 1).astype(jnp.uint32) - has_sentinel.astype(jnp.uint32)
-    cap = jnp.uint32(capacity)
-    dropped_uniques = jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
+    dropped_uniques = _overflow_accounting(key_hi, key_lo, rank, capacity)
     dropped_count = jnp.sum(stream.count) - jnp.sum(count_u)
     return CountTable(
         key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,

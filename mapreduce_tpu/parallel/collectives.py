@@ -60,3 +60,21 @@ def gather_merge(state: T, merge: MergeFn, axis: str) -> T:
 def psum(state: T, axis: str) -> T:
     """Additive all-reduce of a pytree (native XLA collective)."""
     return jax.lax.psum(state, axis)
+
+
+def hierarchical_merge(state: T, merge: MergeFn, axes: tuple[str, ...],
+                       strategy: str = "tree") -> T:
+    """Level-by-level merge over a multi-axis mesh, innermost axis first.
+
+    The multi-slice/multi-host pattern (SURVEY §5 "distributed communication
+    backend"): on a mesh like ``('replica', 'data')`` where the inner axis
+    rides ICI within a slice and the outer axis rides DCN across slices,
+    reducing the fast axis first shrinks what crosses the slow link to one
+    already-merged state per slice — the two-level reduction of the build
+    plan (SURVEY §7 step 4).  Axes are given outermost-first, matching mesh
+    construction order.
+    """
+    fn = tree_merge if strategy == "tree" else gather_merge
+    for axis in reversed(axes):
+        state = fn(state, merge, axis)
+    return state

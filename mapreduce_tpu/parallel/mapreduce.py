@@ -73,23 +73,41 @@ class Engine:
         result = eng.finish(state)              # merged + finalized, replicated
     """
 
-    def __init__(self, job: MapReduceJob, mesh: Mesh, axis: str = "data",
+    def __init__(self, job: MapReduceJob, mesh: Mesh,
+                 axis: str | tuple[str, ...] = "data",
                  merge_strategy: str = "tree"):
-        if axis not in mesh.axis_names:
-            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        for a in axes:
+            if a not in mesh.axis_names:
+                raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
         self.job = job
         self.mesh = mesh
-        self.axis = axis
-        self.n_devices = mesh.shape[axis]
+        self.axis = axes[0] if len(axes) == 1 else axes
+        self.axes = axes
+        self.n_devices = 1
+        for a in axes:
+            self.n_devices *= mesh.shape[a]
         if merge_strategy not in ("tree", "gather"):
             raise ValueError(f"unknown merge_strategy {merge_strategy!r}")
-        self._collective = (collectives.tree_merge if merge_strategy == "tree"
-                            else collectives.gather_merge)
-        self._sharded = mesh_mod.sharded(mesh, axis)
+        # Multi-axis meshes reduce level by level (innermost = fastest link
+        # first); single-axis meshes use the chosen strategy directly.
+        self._collective = functools.partial(
+            collectives.hierarchical_merge, strategy=merge_strategy) \
+            if len(axes) > 1 else \
+            (collectives.tree_merge if merge_strategy == "tree"
+             else collectives.gather_merge)
+        self._sharded = mesh_mod.sharded(mesh, axes if len(axes) > 1 else axes[0])
         self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
         self._step_many_fns: dict[int, Any] = {}
         self._finish_fn = None
+
+    def _device_index(self):
+        """Linear index of this shard across all sharded axes (row-major)."""
+        idx = jax.lax.axis_index(self.axes[0])
+        for a in self.axes[1:]:
+            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        return idx.astype(jnp.uint32)
 
     @property
     def sharding(self):
@@ -114,7 +132,7 @@ class Engine:
         def local_step(state, chunks, step):
             local = jax.tree.map(lambda x: x[0], state)
             chunk = chunks[0]
-            chunk_id = step * jnp.uint32(n) + jax.lax.axis_index(axis).astype(jnp.uint32)
+            chunk_id = step * jnp.uint32(n) + self._device_index()
             update = job.map_chunk(chunk, chunk_id)
             new = job.combine(local, update)
             return jax.tree.map(lambda x: x[None], new)
@@ -133,7 +151,7 @@ class Engine:
         def local_many(state, chunks, step0):
             local = jax.tree.map(lambda x: x[0], state)
             my = chunks[0]  # (k, chunk_bytes) after shard_map
-            dev = jax.lax.axis_index(axis).astype(jnp.uint32)
+            dev = self._device_index()
 
             def body(st, xs):
                 chunk, j = xs

@@ -27,6 +27,30 @@ def data_mesh(n_devices: int | None = None, axis: str = "data",
     return Mesh(np.asarray(devs), (axis,))
 
 
+def two_level_mesh(n_replicas: int, n_data: int | None = None,
+                   axes: tuple[str, str] = ("replica", "data"),
+                   devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """A 2-D mesh for multi-slice/multi-host pods.
+
+    The outer axis (``axes[0]``) is intended to ride the slow link (DCN
+    across slices/hosts), the inner axis the fast one (ICI within a slice);
+    pair with :func:`...collectives.hierarchical_merge`, which reduces the
+    inner axis first.  With ``jax.devices()`` ordered process-major (the JAX
+    contract), outer=process boundary gives exactly that layout.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        if len(devs) % n_replicas:
+            raise ValueError(
+                f"{len(devs)} devices do not divide into {n_replicas} replicas")
+        n_data = len(devs) // n_replicas
+    need = n_replicas * n_data
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_replicas, n_data)
+    return Mesh(grid, axes)
+
+
 def sharded(mesh: Mesh, *axes: str | None) -> NamedSharding:
     """NamedSharding shorthand: sharded(mesh, 'data') == P('data') on mesh."""
     return NamedSharding(mesh, P(*axes))

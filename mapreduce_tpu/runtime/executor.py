@@ -51,9 +51,13 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     """Stream ``path`` through ``job`` over the mesh; see module docstring."""
     logger = logger or get_logger()
     mesh = mesh if mesh is not None else data_mesh()
-    axis = mesh.axis_names[0]
-    n_dev = mesh.shape[axis]
-    engine = Engine(job, mesh, axis=axis, merge_strategy=merge_strategy)
+    # Shard over EVERY mesh axis: a 2-D ('replica','data') mesh contributes
+    # all its devices to the data-parallel stream (the Engine linearizes the
+    # axes row-major; hierarchical merge reduces innermost-first).
+    axes = tuple(mesh.axis_names)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
+                    merge_strategy=merge_strategy)
 
     timer = metrics_mod.PhaseTimer()
     timer.start("total")
@@ -186,7 +190,7 @@ def count_file(path: str, config: Config = DEFAULT_CONFIG, mesh=None,
     mesh = mesh if mesh is not None else data_mesh()
     job = TopKWordCountJob(top_k, config) if top_k else WordCountJob(config)
     rr = run_job(job, path, config=config, mesh=mesh, **kw)
-    n_dev = mesh.shape[mesh.axis_names[0]]
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     result = recover_from_file(rr.value, path, rr.bases, n_dev)
     if top_k:
         result = apply_top_k(result, top_k)

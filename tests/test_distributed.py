@@ -143,3 +143,47 @@ def test_step_many_mixed_with_single_steps(mesh8, rng):
     expected = oracle.word_counts(corpus)
     assert sorted(_table_dict(result).values()) == sorted(expected.values())
     assert int(result.total_count()) == oracle.total_count(corpus)
+
+
+def test_two_level_mesh_engine_matches_oracle(rng):
+    """2-D ('replica','data') mesh with hierarchical (ICI-then-DCN) merge:
+    the multi-slice topology of SURVEY §7 step 4, emulated as 2x4 CPU."""
+    from mapreduce_tpu.parallel.mesh import two_level_mesh
+
+    corpus = make_corpus(rng, n_words=5000, vocab=300)
+    mesh = two_level_mesh(2, 4)
+    eng = Engine(WordCountJob(CFG), mesh, axis=("replica", "data"))
+    assert eng.n_devices == 8
+    batches = [b.data for b in _batches(corpus, 8, CFG.chunk_bytes)]
+    result = eng.run(batches)
+    expected = oracle.word_counts(corpus)
+    assert int(result.n_valid()) == len(expected)
+    assert sorted(_table_dict(result).values()) == sorted(expected.values())
+    assert int(result.total_count()) == oracle.total_count(corpus)
+
+
+def test_two_level_matches_flat_mesh(rng):
+    """Same devices, 1-D vs 2-D mesh: identical tables (chunk ids and all)."""
+    from mapreduce_tpu.parallel.mesh import two_level_mesh
+
+    corpus = make_corpus(rng, n_words=4000, vocab=150)
+    batches = [b.data for b in _batches(corpus, 8, CFG.chunk_bytes)]
+
+    flat = Engine(WordCountJob(CFG), data_mesh(8)).run(batches)
+    two = Engine(WordCountJob(CFG), two_level_mesh(2, 4),
+                 axis=("replica", "data")).run(batches)
+    for fa, fb in zip(flat, two):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_count_file_over_two_level_mesh(tmp_path, rng):
+    """The streaming executor must shard over ALL axes of a 2-D mesh (8
+    shards from 2x4), not just the leading one."""
+    from mapreduce_tpu.parallel.mesh import two_level_mesh
+    from mapreduce_tpu.runtime import executor
+
+    corpus = make_corpus(rng, n_words=4000, vocab=150)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    r = executor.count_file(str(path), config=CFG, mesh=two_level_mesh(2, 4))
+    assert {w: c for w, c in zip(r.words, r.counts)} == oracle.word_counts(corpus)

@@ -1,0 +1,69 @@
+"""Host-side multi-host partitioning logic (pure functions; the collective
+side of multi-host is covered by the emulated-mesh tests in
+test_distributed.py and the driver's dryrun_multichip)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.parallel import distributed as dist
+from mapreduce_tpu.utils import oracle
+
+
+def test_host_byte_ranges_partition_exactly():
+    size = 1_000_003
+    ranges = [dist.host_byte_range(size, p, 8) for p in range(8)]
+    assert ranges[0][0] == 0 and ranges[-1][1] == size
+    for (a_lo, a_hi), (b_lo, b_hi) in zip(ranges, ranges[1:]):
+        assert a_hi == b_lo and a_lo < a_hi
+
+
+def test_host_byte_range_validates_index():
+    with pytest.raises(ValueError):
+        dist.host_byte_range(100, 4, 4)
+
+
+def test_aligned_ranges_count_every_token_once(tmp_path, rng):
+    """Crucial seam property: snapping both ends with the same rule keeps
+    ranges exactly adjacent, and summing per-range counts == global count."""
+    from tests.conftest import make_corpus
+
+    corpus = make_corpus(rng, n_words=3000, vocab=100)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    n_hosts = 4
+    totals: dict[bytes, int] = {}
+    prev_hi = 0
+    for p in range(n_hosts):
+        lo, hi = dist.host_byte_range(len(corpus), p, n_hosts)
+        lo, hi = dist.align_range_to_separator(str(path), lo, hi)
+        assert lo == prev_hi  # ranges stay a partition after snapping
+        prev_hi = hi
+        for w, c in oracle.word_counts(corpus[lo:hi]).items():
+            totals[w] = totals.get(w, 0) + c
+    assert prev_hi == len(corpus)
+    assert totals == oracle.word_counts(corpus)
+
+
+def test_align_handles_separator_free_file(tmp_path):
+    blob = b"x" * 4096  # one giant token, no separators at all
+    path = tmp_path / "b.txt"
+    path.write_bytes(blob)
+    lo, hi = dist.align_range_to_separator(str(path), 1024, 3072,
+                                           max_token_bytes=256)
+    assert (lo, hi) == (1024, 3072)  # falls back to force-split offsets
+
+
+def test_host_shards_are_process_major():
+    assert list(dist.host_shards(16, 1, 4)) == [4, 5, 6, 7]
+    with pytest.raises(ValueError):
+        dist.host_shards(10, 0, 4)
+
+
+def test_initialize_is_noop_on_single_host(monkeypatch):
+    for var in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    dist.initialize()  # must not raise or hang
+    assert dist.is_coordinator()
