@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
+    p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
+                   help="pallas backend: tokens longer than W bytes are "
+                        "dropped into dropped_* accounting (xla counts any "
+                        "length)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
     return p
@@ -98,7 +102,8 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
-                        backend=args.backend, superstep=args.superstep)
+                        backend=args.backend, superstep=args.superstep,
+                        pallas_max_token=args.max_token_bytes)
     except ValueError as e:
         parser.error(str(e))
 

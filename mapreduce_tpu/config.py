@@ -61,11 +61,14 @@ class Config:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
-        if self.backend != "xla" and self.pallas_max_token < 1:
+        if self.backend != "xla" and not 1 <= self.pallas_max_token <= 512:
             # 'auto' may resolve to pallas at runtime; fail at construction,
-            # not mid-trace inside the kernel.
+            # not mid-trace inside the kernel.  The upper bound keeps the
+            # kernel's unrolled W-step lookback loop compilable; tokens
+            # longer than W are accounted, and the xla backend handles any
+            # length exactly.
             raise ValueError(
-                f"pallas_max_token must be >= 1, got {self.pallas_max_token}")
+                f"pallas_max_token must be in [1, 512], got {self.pallas_max_token}")
         if self.backend == "pallas" and self.chunk_bytes < self.pallas_min_chunk:
             # Seam windows must not overlap: lane segment >= 2W+2 bytes.
             # ('auto' instead falls back to xla for chunks this small.)

@@ -68,11 +68,17 @@ def _aligned_cuts(buf: np.ndarray, n_shards: int, chunk_bytes: int,
 
 def iter_batches(path: str, n_shards: int, chunk_bytes: int,
                  max_token_bytes: int = 4096, start_offset: int = 0,
-                 start_step: int = 0, use_native: bool = True) -> Iterator[Batch]:
+                 start_step: int = 0, use_native: bool = True,
+                 end_offset: int | None = None) -> Iterator[Batch]:
     """Stream a file as boundary-aligned [n_shards, chunk_bytes] batches.
 
     ``start_offset``/``start_step`` support checkpoint resume: iteration
-    continues from a previously reported cursor.  The batch fill runs in the
+    continues from a previously reported cursor.  ``end_offset`` bounds the
+    stream to the half-open byte range ``[start_offset, end_offset)`` — the
+    multi-host case, where each host reads only its own
+    :func:`...parallel.distributed.host_byte_range` (pre-aligned via
+    ``align_range_to_separator``, so the range end IS a token boundary and
+    the usual EOF alignment rule applies at it).  The batch fill runs in the
     native chunker (:mod:`mapreduce_tpu.native`) when available, falling back
     to the pure-numpy path; both produce byte-identical batches
     (tests/test_native.py asserts parity).
@@ -81,11 +87,13 @@ def iter_batches(path: str, n_shards: int, chunk_bytes: int,
 
     mm = np.memmap(path, dtype=np.uint8, mode="r") if _file_size(path) else None
     total = 0 if mm is None else mm.shape[0]
+    if end_offset is not None:
+        total = min(total, end_offset)
     offset = start_offset
     step = start_step
     stride = n_shards * chunk_bytes
     while offset < total:
-        raw = np.asarray(mm[offset: offset + stride])
+        raw = np.asarray(mm[offset: min(offset + stride, total)])
         at_eof = offset + raw.shape[0] >= total
         data = np.empty((n_shards, chunk_bytes), dtype=np.uint8)
         bases = np.empty((n_shards,), dtype=np.int64)

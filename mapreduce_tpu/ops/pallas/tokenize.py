@@ -237,7 +237,7 @@ def _seam_pass(data: jax.Array, seg_len: int, w: int,
 
 def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
              max_token_bytes: int = DEFAULT_MAX_TOKEN,
-             block_rows: int = DEFAULT_BLOCK_ROWS,
+             block_rows: int | None = None,
              interpret: bool | None = None) -> tuple[TokenStream, jax.Array]:
     """Pallas-backed tokenize: returns ``(stream, overlong_count)``.
 
@@ -264,11 +264,14 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
     if w < 1:
         raise ValueError(f"max_token_bytes must be >= 1, got {w}")
     seg_len = n // LANES
+    if block_rows is None:
+        # Blocks must cover the W-row lookback plus one row, and stay even
+        # (pairwise compaction halves the output rows, which are a multiple
+        # of block_rows).
+        block_rows = max(DEFAULT_BLOCK_ROWS, w + 2 + (w % 2))
     if block_rows < w + 2:
         raise ValueError(f"block_rows {block_rows} must be >= max_token_bytes+2")
     if block_rows % 2:
-        # Pairwise compaction halves the output rows; rows are a multiple of
-        # block_rows, so the block count must keep them even.
         raise ValueError(f"block_rows must be even, got {block_rows}")
     if seg_len < 2 * w + 2:
         raise ValueError(

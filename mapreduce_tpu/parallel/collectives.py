@@ -74,6 +74,8 @@ def hierarchical_merge(state: T, merge: MergeFn, axes: tuple[str, ...],
     plan (SURVEY §7 step 4).  Axes are given outermost-first, matching mesh
     construction order.
     """
+    if strategy not in ("tree", "gather"):
+        raise ValueError(f"unknown strategy {strategy!r}")
     fn = tree_merge if strategy == "tree" else gather_merge
     for axis in reversed(axes):
         state = fn(state, merge, axis)

@@ -15,8 +15,10 @@ Multi-host flow::
     dist.initialize()                      # no-op on a single host
     mesh = dist.global_data_mesh()         # all chips, all hosts
     lo, hi = dist.host_byte_range(os.path.getsize(path))
-    # each host streams [lo, hi) and feeds its local devices; the engine's
-    # collective merge produces the identical replicated result everywhere.
+    lo, hi = dist.align_range_to_separator(path, lo, hi)
+    rr = executor.run_job(job, path, mesh=mesh, byte_range=(lo, hi))
+    # each host streams only [lo, hi); the collective merge (or a host-side
+    # table merge when driven per-host) yields the identical global result.
 
 ``initialize`` wraps :func:`jax.distributed.initialize`, which reads the
 cluster-environment variables (coordinator address, process count/index) that

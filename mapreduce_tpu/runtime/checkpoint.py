@@ -29,7 +29,8 @@ class CheckpointMismatch(RuntimeError):
 
 
 def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
-                    backend: str = "xla", pallas_max_token: int = 0) -> dict:
+                    backend: str = "xla", pallas_max_token: int = 0,
+                    byte_range: tuple[int, int] | None = None) -> dict:
     """Identity of a run: resuming under a different identity is an error.
 
     The input file is fingerprinted by size + a head/tail content hash, so a
@@ -50,12 +51,14 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
     return {"input_size": size, "input_hash": h.hexdigest(),
             "n_devices": n_devices, "chunk_bytes": chunk_bytes,
             "backend": backend,
-            "pallas_max_token": pallas_max_token if backend == "pallas" else 0}
+            "pallas_max_token": pallas_max_token if backend == "pallas" else 0,
+            "byte_range": list(byte_range) if byte_range else None}
 
 
 # Values assumed for fingerprint keys absent from an older checkpoint's meta
 # (i.e. the only behavior that existed before the key was introduced).
-_FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0}
+_FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0,
+                         "byte_range": None}
 
 
 def save(path: str, state: CountTable, step: int, offset: int,

@@ -47,26 +47,36 @@ class RunResult:
 def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
-            logger=None, progress_every: int = 50) -> RunResult:
-    """Stream ``path`` through ``job`` over the mesh; see module docstring."""
+            logger=None, progress_every: int = 50,
+            byte_range: Optional[tuple[int, int]] = None) -> RunResult:
+    """Stream ``path`` through ``job`` over the mesh; see module docstring.
+
+    ``byte_range``: restrict ingestion to ``[lo, hi)`` — this host's slice of
+    a multi-host corpus (:func:`...parallel.distributed.host_byte_range`,
+    pre-aligned with ``align_range_to_separator``).  The returned value is
+    then this host's *partial* state; the cross-host merge happens via the
+    engine's collective when all hosts run one global program, or host-side
+    ``table_ops.merge`` when driven per-host.
+    """
     logger = logger or get_logger()
     mesh = mesh if mesh is not None else data_mesh()
     # Shard over EVERY mesh axis: a 2-D ('replica','data') mesh contributes
     # all its devices to the data-parallel stream (the Engine linearizes the
     # axes row-major; hierarchical merge reduces innermost-first).
     axes = tuple(mesh.axis_names)
-    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    n_dev = mesh.size  # == product over all axes, which we shard in full
     engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
                     merge_strategy=merge_strategy)
+    range_lo, range_hi = byte_range if byte_range is not None else (0, None)
 
     timer = metrics_mod.PhaseTimer()
     timer.start("total")
 
-    start_step, start_offset = 0, 0
+    start_step, start_offset = 0, range_lo
     bases_list: list[np.ndarray] = []
     fingerprint = ckpt_mod.run_fingerprint(
         path, n_dev, config.chunk_bytes, backend=config.resolved_backend(),
-        pallas_max_token=config.pallas_max_token) \
+        pallas_max_token=config.pallas_max_token, byte_range=byte_range) \
         if checkpoint_path else None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         state_np, start_step, start_offset, bases_arr = ckpt_mod.load(
@@ -131,7 +141,8 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     timer.start("stream")
     for batch in reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
                                          start_offset=start_offset,
-                                         start_step=start_step):
+                                         start_step=start_step,
+                                         end_offset=range_hi):
         pending.append(batch)
         if len(pending) == k:
             state = flush(state, pending)
@@ -190,7 +201,7 @@ def count_file(path: str, config: Config = DEFAULT_CONFIG, mesh=None,
     mesh = mesh if mesh is not None else data_mesh()
     job = TopKWordCountJob(top_k, config) if top_k else WordCountJob(config)
     rr = run_job(job, path, config=config, mesh=mesh, **kw)
-    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    n_dev = mesh.size
     result = recover_from_file(rr.value, path, rr.bases, n_dev)
     if top_k:
         result = apply_top_k(result, top_k)

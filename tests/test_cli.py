@@ -90,3 +90,16 @@ def test_top_k(tmp_path):
     r = _run([str(f), "--top-k", "2", "--format", "tsv"])
     assert r.returncode == 0, r.stderr
     assert r.stdout == "a\t3\nb\t2\n"
+
+
+def test_max_token_bytes_flag_on_pallas_backend(tmp_path):
+    """--max-token-bytes reaches the pallas config: a token longer than W is
+    dropped into the accounting, shorter ones count normally."""
+    f = tmp_path / "in.txt"
+    f.write_text("short " + "L" * 40 + " short\n")
+    r = _run([str(f), "--format", "json", "--backend", "pallas",
+              "--chunk-bytes", str(128 * 18), "--max-token-bytes", "8"])
+    assert r.returncode == 0, r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["counts"] == [["short", 2]]
+    assert obj["total"] == 3 and obj["dropped_count"] == 1

@@ -67,3 +67,42 @@ def test_initialize_is_noop_on_single_host(monkeypatch):
         monkeypatch.delenv(var, raising=False)
     dist.initialize()  # must not raise or hang
     assert dist.is_coordinator()
+
+
+def test_per_host_byte_range_runs_merge_to_global_counts(tmp_path, rng):
+    """The full documented multi-host flow, emulated in-process: each 'host'
+    streams only its aligned [lo, hi) range (run_job byte_range), and the
+    merged per-host tables equal a single global run."""
+    from tests.conftest import make_corpus
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.models.wordcount import WordCountJob
+    from mapreduce_tpu.ops import table as table_ops
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import executor
+
+    corpus = make_corpus(rng, n_words=3000, vocab=120)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=512, table_capacity=1024)
+    mesh = data_mesh(2)
+    job = WordCountJob(cfg)
+
+    n_hosts = 3
+    partials = []
+    for p in range(n_hosts):
+        lo, hi = dist.host_byte_range(len(corpus), p, n_hosts)
+        lo, hi = dist.align_range_to_separator(str(path), lo, hi)
+        rr = executor.run_job(job, str(path), config=cfg, mesh=mesh,
+                              byte_range=(lo, hi))
+        partials.append(rr.value)
+
+    merged = partials[0]
+    for t in partials[1:]:
+        merged = table_ops.merge(merged, t, capacity=cfg.table_capacity)
+
+    got = {(int(h), int(l)): int(c) for h, l, c in
+           zip(np.asarray(merged.key_hi), np.asarray(merged.key_lo),
+               np.asarray(merged.count)) if c > 0}
+    expected = oracle.word_counts(corpus)
+    assert sorted(got.values()) == sorted(expected.values())
+    assert int(np.asarray(merged.total_count())) == oracle.total_count(corpus)
