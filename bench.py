@@ -16,10 +16,11 @@ owns.  Host->device staging is measured and reported separately
 host, local DMA far exceeds the pipeline rate and the headline number is the
 end-to-end bound.
 
-Env knobs: BENCH_MB (corpus size, default 384), BENCH_CHUNK_MB (per-device
+Env knobs: BENCH_MB (corpus size, default 512), BENCH_CHUNK_MB (per-device
 step size, default 32 — the measured sweet spot on v5e), BENCH_SUPERSTEP
-(chunks folded per dispatch via lax.scan, default 4), BENCH_BASELINE_MB
-(CPU baseline slice, default 16).
+(chunks folded per dispatch via lax.scan, default 8 — fewer, larger
+dispatches dilute per-dispatch link latency), BENCH_BASELINE_MB (CPU
+baseline slice, default 16).
 """
 
 from __future__ import annotations
@@ -58,9 +59,9 @@ def cpu_baseline_gbps(data: bytes, repeats: int = 1) -> float:
 
 
 def main() -> int:
-    mb = int(os.environ.get("BENCH_MB", "384"))
+    mb = int(os.environ.get("BENCH_MB", "512"))
     chunk_mb = int(os.environ.get("BENCH_CHUNK_MB", "32"))
-    superstep = int(os.environ.get("BENCH_SUPERSTEP", "4"))
+    superstep = int(os.environ.get("BENCH_SUPERSTEP", "8"))
     base_mb = int(os.environ.get("BENCH_BASELINE_MB", "16"))
 
     corpus = make_zipf_corpus(mb << 20)

@@ -235,16 +235,25 @@ def _seam_pass(data: jax.Array, seg_len: int, w: int,
     return stream, overlong
 
 
-def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
-             max_token_bytes: int = DEFAULT_MAX_TOKEN,
-             block_rows: int | None = None,
-             interpret: bool | None = None) -> tuple[TokenStream, jax.Array]:
-    """Pallas-backed tokenize: returns ``(stream, overlong_count)``.
+def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
+                   max_token_bytes: int = DEFAULT_MAX_TOKEN,
+                   block_rows: int | None = None,
+                   interpret: bool | None = None
+                   ) -> tuple[TokenStream, TokenStream, jax.Array]:
+    """Pallas-backed tokenize returning ``(col_stream, seam_stream, overlong)``
+    — the bulk column-pass emissions and the tiny (~129*(2W+2) entries) seam
+    fix-up emissions as *separate* streams.
+
+    Aggregation-aware callers should consume the two streams separately
+    (build a table from each and merge): concatenating them forces a full
+    copy of every multi-hundred-MB column plane just to append a few KB.
+    :func:`tokenize` below does exactly that concatenation for callers that
+    want the single-stream view.
 
     Emits the same (key, count, pos, length) tuples per token as
     :func:`mapreduce_tpu.ops.tokenize.tokenize` for every token of at most
     ``max_token_bytes`` bytes; longer tokens are dropped and tallied in the
-    returned ``overlong_count`` (uint32 scalar) for the caller to fold into
+    returned ``overlong`` (uint32 scalar) for the caller to fold into
     ``CountTable.dropped_*``.  Stream entries are NOT in byte order (the
     column view interleaves lanes); downstream aggregation sorts by key, so
     order is irrelevant there.
@@ -322,7 +331,15 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
         pos=start.reshape(-1), length=ln.reshape(-1))
 
     seam_stream, over_seams = _seam_pass(data, seg_len, w, base_offset)
+    return col_stream, seam_stream, over_cols + over_seams
 
+
+def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
+             max_token_bytes: int = DEFAULT_MAX_TOKEN,
+             block_rows: int | None = None,
+             interpret: bool | None = None) -> tuple[TokenStream, jax.Array]:
+    """Single-stream view of :func:`tokenize_split`: ``(stream, overlong)``."""
+    col, seam, overlong = tokenize_split(data, base_offset, max_token_bytes,
+                                         block_rows, interpret)
     cat = lambda a, b: jnp.concatenate([a, b])
-    stream = TokenStream(*(cat(a, b) for a, b in zip(col_stream, seam_stream)))
-    return stream, over_cols + over_seams
+    return TokenStream(*(cat(a, b) for a, b in zip(col, seam))), overlong

@@ -8,17 +8,28 @@ ICI/DCN mesh; what remains host-side is (a) bringing every process into one
 JAX runtime and (b) deciding which byte-range of the corpus each host reads.
 This module owns both.
 
-Multi-host flow::
+Two multi-host modes::
 
     from mapreduce_tpu.parallel import distributed as dist
 
     dist.initialize()                      # no-op on a single host
-    mesh = dist.global_data_mesh()         # all chips, all hosts
+
+    # (a) per-host-driven (tested end-to-end in tests/test_multihost.py):
+    #     each host runs the executor over its OWN devices and its own
+    #     byte-range, then partial tables are merged (host-side
+    #     table_ops.merge, or any reduction transport).
     lo, hi = dist.host_byte_range(os.path.getsize(path))
     lo, hi = dist.align_range_to_separator(path, lo, hi)
-    rr = executor.run_job(job, path, mesh=mesh, byte_range=(lo, hi))
-    # each host streams only [lo, hi); the collective merge (or a host-side
-    # table merge when driven per-host) yields the identical global result.
+    rr = executor.run_job(job, path, byte_range=(lo, hi))   # local mesh
+
+    # (b) one global SPMD program: a global mesh plus per-host staging.
+    #     Each host reads only its own shard rows and places them with
+    #     device_put_local (make_array_from_process_local_data); the
+    #     resulting global arrays feed Engine.step/step_many directly
+    #     (device_put on an already-sharded array is a no-op), and the
+    #     engine's collective finish replicates the result everywhere.
+    #     run_job's convenience staging is host-local numpy and therefore
+    #     single-host; mode (b) drives the Engine, not run_job.
 
 ``initialize`` wraps :func:`jax.distributed.initialize`, which reads the
 cluster-environment variables (coordinator address, process count/index) that

@@ -54,9 +54,13 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     ``byte_range``: restrict ingestion to ``[lo, hi)`` — this host's slice of
     a multi-host corpus (:func:`...parallel.distributed.host_byte_range`,
     pre-aligned with ``align_range_to_separator``).  The returned value is
-    then this host's *partial* state; the cross-host merge happens via the
-    engine's collective when all hosts run one global program, or host-side
-    ``table_ops.merge`` when driven per-host.
+    then this host's *partial* state, to be merged host-side
+    (``table_ops.merge``) across hosts.  Note this per-host-driven mode uses
+    a host-LOCAL mesh: run_job stages plain numpy batches, so a mesh spanning
+    non-addressable devices is not supported here — for one global SPMD
+    program over all hosts, stage shards with
+    ``distributed.device_put_local`` and drive ``Engine.step`` directly
+    (see :mod:`mapreduce_tpu.parallel.distributed`).
     """
     logger = logger or get_logger()
     mesh = mesh if mesh is not None else data_mesh()
@@ -158,7 +162,9 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     total_s = timer.stop("total")
 
     words = int(value.total_count()) if isinstance(value, table_ops.CountTable) else 0
-    m = metrics_mod.RunMetrics(bytes_processed=bytes_done, words_counted=words,
+    # bytes_done is the absolute resume CURSOR (checkpoints store it); the
+    # throughput metric counts only bytes this run actually streamed.
+    m = metrics_mod.RunMetrics(bytes_processed=bytes_done - range_lo, words_counted=words,
                                elapsed_s=total_s, phases=dict(timer.phases))
     log_event(logger, "run complete", **m.as_dict())
     bases = np.stack(bases_list) if bases_list else np.zeros((0, n_dev), np.int64)
