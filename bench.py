@@ -64,9 +64,23 @@ def main() -> int:
     superstep = int(os.environ.get("BENCH_SUPERSTEP", "8"))
     base_mb = int(os.environ.get("BENCH_BASELINE_MB", "16"))
 
-    corpus = make_zipf_corpus(mb << 20)
+    # BENCH_INPUT: bench a real corpus file (e.g. enwik8/enwik9 per
+    # BASELINE.md) instead of the synthetic Zipf text.
+    input_path = os.environ.get("BENCH_INPUT")
+    if input_path:
+        with open(input_path, "rb") as f:
+            corpus = f.read(mb << 20)
+    else:
+        corpus = make_zipf_corpus(mb << 20)
 
     import jax
+
+    from mapreduce_tpu.runtime import profiling
+
+    # Persistent compile cache: repeated bench runs (and later rounds) skip
+    # the multi-minute first compile when shapes are unchanged.
+    # (BENCH_COMPILE_CACHE overrides; empty disables.)
+    profiling.enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
 
     from mapreduce_tpu.config import Config
     from mapreduce_tpu.data import reader
@@ -134,11 +148,12 @@ def main() -> int:
 
     print(json.dumps({
         "metric": "zipf_wordcount_device_throughput",
+        "input": os.path.basename(input_path) if input_path else "synthetic-zipf",
         "h2d_gbps": round(h2d_gbps, 4),
         "value": round(gbps, 4),
         "unit": "GB/s",
         "vs_baseline": round(gbps / base, 3) if base else 0.0,
-        "corpus_mb": mb,
+        "corpus_mb": round(len(corpus) / (1 << 20), 1),  # actual, not requested
         "devices": n_dev,
         "backend": jax.devices()[0].platform,
         "total_words": total_words,

@@ -109,6 +109,10 @@ def main(argv: list[str] | None = None) -> int:
 
     from mapreduce_tpu.runtime import profiling
 
+    # Persistent XLA compile cache (multi-minute first compiles otherwise;
+    # MAPREDUCE_COMPILE_CACHE overrides the location, empty disables).
+    profiling.enable_compile_cache()
+
     t0 = time.perf_counter()
     with profiling.trace(args.profile):
         if args.stream:

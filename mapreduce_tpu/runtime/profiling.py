@@ -38,3 +38,29 @@ def region(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> None:
+    """Point JAX at a persistent compilation cache.
+
+    First compiles of the streaming step are multi-minute programs; the cache
+    makes every later same-shape run (CLI or bench, same process or not)
+    skip them.  Default location: ``~/.cache/jax_mapreduce``, overridable via
+    ``MAPREDUCE_COMPILE_CACHE`` (set it empty to disable).  Best-effort: a
+    cache failure must never take down a run.
+    """
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "MAPREDUCE_COMPILE_CACHE",
+            os.path.expanduser("~/.cache/jax_mapreduce"))
+    if not cache_dir:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
