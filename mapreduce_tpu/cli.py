@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: fold K chunks into one dispatch "
                         "(lax.scan) to amortize per-dispatch overhead")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
+    p.add_argument("--distinct-sketch", action="store_true",
+                   help="with --stream: carry a HyperLogLog so the distinct "
+                        "count stays accurate past table capacity "
+                        "(distinct_estimate in json output)")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -119,6 +123,7 @@ def main(argv: list[str] | None = None) -> int:
             from mapreduce_tpu.runtime.executor import count_file
 
             result = count_file(args.input, config=config, top_k=args.top_k or None,
+                                distinct_sketch=args.distinct_sketch,
                                 checkpoint_path=args.checkpoint,
                                 checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
         else:
@@ -149,13 +154,16 @@ def main(argv: list[str] | None = None) -> int:
     else:
         # "counts" is a list of pairs, not an object: distinct byte words must
         # stay distinct entries even if their display decodings collide.
-        out.write(json.dumps({
+        payload = {
             "counts": [[w, c] for w, c in zip(display, counts)],
             "total": result.total,
             "distinct": result.distinct,
             "dropped_uniques": result.dropped_uniques,
             "dropped_count": result.dropped_count,
-        }) + "\n")
+        }
+        if result.distinct_estimate is not None:
+            payload["distinct_estimate"] = round(result.distinct_estimate, 1)
+        out.write(json.dumps(payload) + "\n")
 
     if args.stats:
         gb = input_bytes / 1e9

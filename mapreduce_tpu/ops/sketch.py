@@ -1,0 +1,86 @@
+"""HyperLogLog distinct-count sketch (a second model family).
+
+The reference can report at most 10 distinct words before silently
+corrupting memory (``MAX_OUTPUT_COUNT``, ``main.cu:13,103-104``); the count
+table here is exact up to its configured capacity and *accounts* what it
+drops, but past capacity the distinct count degrades to an upper bound
+(``CountTable.dropped_uniques``).  The sketch closes that gap: a fixed
+2**p-register HyperLogLog tracks the number of distinct keys with ~1.04/√m
+relative error at any corpus size, in O(KB) of state.
+
+TPU-first shape of the design:
+
+* Registers update from **deduplicated per-chunk table keys** (the ≤64K-row
+  batch table the map phase already builds), never from the raw multi-million
+  entry token stream — scatter cost scales with input size on TPU, and
+  re-scattering duplicate tokens is pure waste.  HLL's register-max is
+  idempotent, so cross-chunk duplicates are harmless.
+* The register update is one ``scatter-max``; the cross-device/cross-chunk
+  merge is elementwise ``maximum`` — an associative, commutative monoid that
+  rides :func:`...collectives.tree_merge` (or ``lax.pmax``) like any other
+  accumulator in this framework.
+* The keys are the tokenizer's 64-bit hashes (khi, klo), already
+  avalanche-finalized (murmur fmix, ``ops/tokenize.py``) — no rehashing.
+
+Estimation (host-side, numpy float64) uses the standard bias-corrected HLL
+estimator with the small-range (linear counting) correction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_tpu import constants
+
+DEFAULT_PRECISION = 14  # 2**14 registers = 64 KiB of uint32; ~0.8% error
+
+
+def empty(precision: int = DEFAULT_PRECISION) -> jax.Array:
+    """Zeroed registers, uint32[2**precision]."""
+    if not 4 <= precision <= 18:
+        raise ValueError(f"precision must be in [4, 18], got {precision}")
+    return jnp.zeros((1 << precision,), dtype=jnp.uint32)
+
+
+def _bit_length(x: jax.Array) -> jax.Array:
+    """Per-lane bit length of a uint32 (0 for 0), elementwise (no clz on
+    the VPU; 5-step binary search)."""
+    n = jnp.zeros(x.shape, jnp.uint32)
+    for shift in (16, 8, 4, 2, 1):
+        big = x >= (jnp.uint32(1) << shift)
+        n = jnp.where(big, n + shift, n)
+        x = jnp.where(big, x >> shift, x)
+    return n + (x > 0).astype(jnp.uint32)
+
+
+def update_from_keys(registers: jax.Array, key_hi: jax.Array,
+                     key_lo: jax.Array, valid: jax.Array) -> jax.Array:
+    """Fold a batch of 64-bit keys into the registers.
+
+    ``valid`` masks real rows (count-table slots may be empty/sentinel).
+    Bucket = low p bits of key_hi; rho = leading-zero count of key_lo + 1
+    (klo == 0 maps to the max rho, 33, as the all-zero suffix).
+    """
+    bucket = (key_hi & jnp.uint32(registers.shape[0] - 1)).astype(jnp.int32)
+    rho = jnp.uint32(33) - _bit_length(key_lo)
+    rho = jnp.where(valid, rho, jnp.uint32(0))  # max with 0 = no-op
+    return registers.at[bucket].max(rho, mode="drop")
+
+
+def merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Associative, commutative, idempotent register merge."""
+    return jnp.maximum(a, b)
+
+
+def estimate(registers: np.ndarray | jax.Array) -> float:
+    """Bias-corrected HLL cardinality estimate (host-side)."""
+    regs = np.asarray(registers, dtype=np.float64)
+    m = regs.shape[0]
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1 + 1.079 / m))
+    raw = alpha * m * m / np.sum(np.exp2(-regs))
+    zeros = int(np.sum(regs == 0))
+    if raw <= 2.5 * m and zeros:
+        return float(m * np.log(m / zeros))  # linear counting, small range
+    return float(raw)

@@ -62,7 +62,8 @@ _FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0,
 
 
 def save(path: str, state: CountTable, step: int, offset: int,
-         bases: np.ndarray, fingerprint: dict | None = None) -> None:
+         bases: np.ndarray, fingerprint: dict | None = None,
+         extras: dict[str, np.ndarray] | None = None) -> None:
     """Atomically persist a run snapshot.
 
     Args:
@@ -71,8 +72,12 @@ def save(path: str, state: CountTable, step: int, offset: int,
       offset: file offset ingest should resume from.
       bases: int64[steps_done, D] absolute row base offsets so far.
       fingerprint: run identity from :func:`run_fingerprint`.
+      extras: additional named arrays riding the snapshot (e.g. HLL sketch
+        registers).  Round-tripped verbatim by :func:`load`.
     """
     payload = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
+    for k, v in (extras or {}).items():
+        payload[f"__extra_{k}"] = np.asarray(v)
     payload["__step"] = np.int64(step)
     payload["__offset"] = np.int64(offset)
     payload["__bases"] = np.asarray(bases, dtype=np.int64)
@@ -92,12 +97,14 @@ def save(path: str, state: CountTable, step: int, offset: int,
 
 
 def load(path: str, expect_fingerprint: dict | None = None
-         ) -> tuple[CountTable, int, int, np.ndarray]:
-    """Load a snapshot; returns (state, step, offset, bases).
+         ) -> tuple[CountTable, int, int, np.ndarray, dict[str, np.ndarray]]:
+    """Load a snapshot; returns (state, step, offset, bases, extras).
 
-    If ``expect_fingerprint`` is given, raises :class:`CheckpointMismatch`
-    when the snapshot came from a different input file, device count, or
-    chunk size — silently resuming across those would corrupt counts.
+    ``extras`` round-trips whatever :func:`save` was given (empty dict for
+    snapshots written without extras).  If ``expect_fingerprint`` is given,
+    raises :class:`CheckpointMismatch` when the snapshot came from a
+    different input file, device count, or chunk size — silently resuming
+    across those would corrupt counts.
     """
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta"]).decode() or "{}") if "__meta" in z else {}
@@ -113,7 +120,9 @@ def load(path: str, expect_fingerprint: dict | None = None
                         f"this run has {key}={want!r}; delete the checkpoint "
                         f"or rerun with the original configuration")
         state = CountTable(**{f: z[f] for f in _FIELDS})
-        return state, int(z["__step"]), int(z["__offset"]), z["__bases"]
+        extras = {k[len("__extra_"):]: z[k] for k in z.files
+                  if k.startswith("__extra_")}
+        return state, int(z["__step"]), int(z["__offset"]), z["__bases"], extras
 
 
 def exists(path: str) -> bool:

@@ -68,7 +68,7 @@ def test_checkpoint_resume_same_result(tmp_path, rng):
     # from the last checkpoint and re-running.
     executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck, checkpoint_every=1)
     assert ckpt.exists(ck)
-    state, step, offset, bases = ckpt.load(ck)
+    state, step, offset, bases, extras = ckpt.load(ck)
     assert step > 1 and 0 < offset <= len(corpus)
 
     resumed = executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck,
@@ -142,7 +142,7 @@ def test_checkpoint_roundtrip(tmp_path):
     stacked = jax.tree.map(lambda x: np.broadcast_to(np.asarray(x)[None], (4,) + x.shape), t)
     p = str(tmp_path / "ck.npz")
     ckpt.save(p, stacked, step=3, offset=12345, bases=np.zeros((3, 4), np.int64))
-    s2, step, offset, bases = ckpt.load(p)
+    s2, step, offset, bases, extras = ckpt.load(p)
     assert step == 3 and offset == 12345 and bases.shape == (3, 4)
     for f in t._fields:
         np.testing.assert_array_equal(np.asarray(getattr(stacked, f)),
@@ -162,3 +162,27 @@ def test_stream_superstep_matches_single_step(tmp_path, rng):
     r3 = executor.count_file(str(path), config=Config(**base, superstep=3))
     assert r1.as_dict() == r3.as_dict()
     assert r1.words == r3.words and r1.total == r3.total
+
+
+def test_sketched_checkpoint_resume(tmp_path, rng):
+    """Sketched runs checkpoint (table + HLL registers as extras) and resume
+    to the same result; resuming across sketched/unsketched is rejected."""
+    corpus = make_corpus(rng, n_words=4000, vocab=600)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=512, table_capacity=256)
+    ck = str(tmp_path / "ck.npz")
+
+    full = executor.count_file(str(path), config=cfg, distinct_sketch=True)
+    # Run with frequent checkpoints; then resume from the on-disk snapshot.
+    r1 = executor.count_file(str(path), config=cfg, distinct_sketch=True,
+                             checkpoint_path=ck, checkpoint_every=2)
+    assert ckpt.exists(ck)  # sketched state DID snapshot
+    r2 = executor.count_file(str(path), config=cfg, distinct_sketch=True,
+                             checkpoint_path=ck, checkpoint_every=2)
+    assert r1.as_dict() == full.as_dict() == r2.as_dict()
+    assert r2.distinct_estimate == pytest.approx(r1.distinct_estimate)
+
+    with pytest.raises(ckpt.CheckpointMismatch, match="sketch"):
+        executor.count_file(str(path), config=cfg, distinct_sketch=False,
+                            checkpoint_path=ck, checkpoint_every=2)
