@@ -1,0 +1,19 @@
+"""Smallest possible use: exact word counts for an in-memory buffer.
+
+    python examples/basic_wordcount.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mapreduce_tpu.models import wordcount
+
+text = b"to be or not to be that is the question"
+result = wordcount.count_words(text)
+
+for word, count in zip(result.words, result.counts):  # insertion order
+    print(f"{word.decode()}\t{count}")
+print(f"total={result.total} distinct={result.distinct}")
+assert result.as_dict()[b"to"] == 2
