@@ -123,6 +123,57 @@ def iter_batches(path: str, n_shards: int, chunk_bytes: int,
         step += 1
 
 
+def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
+    """Run an iterator in a background thread, ``depth`` items ahead.
+
+    Double-buffers ingest against device compute (SURVEY §7 step 4): while
+    the devices chew on step N, the host memmap-reads and boundary-aligns
+    step N+1.  The producer thread is daemonic and bounded by a queue, so an
+    abandoned consumer cannot leak unbounded memory; producer exceptions are
+    re-raised at the consumer's next pull.
+    """
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END, _ERR = object(), object()
+
+    def put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for b in batches:
+                if not put(b):
+                    return  # consumer abandoned the stream
+            put(_END)
+        except BaseException as e:  # surfaced on the consumer side
+            put((_ERR, e))
+
+    t = threading.Thread(target=produce, daemon=True, name="ingest-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        # Early exit (consumer error/close): release the producer so it does
+        # not sit blocked on a full queue holding batches and the memmap.
+        stop.set()
+
+
 def _file_size(path: str) -> int:
     import os
 

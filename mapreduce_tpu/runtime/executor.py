@@ -177,10 +177,13 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
         return state
 
     timer.start("stream")
-    for batch in reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
-                                         start_offset=start_offset,
-                                         start_step=start_step,
-                                         end_offset=range_hi):
+    # Prefetch: host-side chunking of step N+1 overlaps device compute of
+    # step N (the double-buffering of SURVEY §7 step 4).
+    for batch in reader_mod.prefetch(
+            reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
+                                    start_offset=start_offset,
+                                    start_step=start_step,
+                                    end_offset=range_hi)):
         pending.append(batch)
         if len(pending) == k:
             state = flush(state, pending)
@@ -244,8 +247,9 @@ def count_file(path: str, config: Config = DEFAULT_CONFIG, mesh=None,
 
     ``distinct_sketch`` composes a HyperLogLog over the run, populating
     ``result.distinct_estimate`` — accurate (~0.8%) even when distinct words
-    spill past table capacity.  (Sketched state is not checkpointable yet:
-    the executor logs and skips snapshots for non-CountTable states.)
+    spill past table capacity.  Sketched runs checkpoint like plain ones
+    (the registers ride snapshots as extras); resuming a checkpoint across
+    sketched/unsketched configurations raises CheckpointMismatch.
     """
     mesh = mesh if mesh is not None else data_mesh()
     job = TopKWordCountJob(top_k, config) if top_k else WordCountJob(config)

@@ -83,3 +83,48 @@ def test_read_words_at(tmp_path):
     path = _write(tmp_path, b"alpha beta gamma")
     assert reader.read_words_at(path, [(0, 5), (6, 4), (11, 5)]) == \
         [b"alpha", b"beta", b"gamma"]
+
+
+def test_prefetch_preserves_stream(tmp_path, rng):
+    """prefetch() must yield exactly the same batches, in order."""
+
+    corpus = make_corpus(rng, n_words=2000, vocab=100)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    direct = list(reader.iter_batches(str(path), 2, 512))
+    fetched = list(reader.prefetch(reader.iter_batches(str(path), 2, 512)))
+    assert len(direct) == len(fetched) and len(direct) > 2
+    for a, b in zip(direct, fetched):
+        np.testing.assert_array_equal(a.data, b.data)
+        np.testing.assert_array_equal(a.base_offsets, b.base_offsets)
+        assert a.step == b.step
+
+
+def test_prefetch_propagates_producer_errors():
+    def gen():
+        raise RuntimeError("disk on fire")
+        yield  # pragma: no cover
+
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        list(reader.prefetch(gen()))
+
+
+def test_prefetch_abandoned_consumer_stops_producer(tmp_path, rng):
+    """Dropping the generator early must release the producer thread."""
+    import threading
+    import time
+
+    corpus = make_corpus(rng, n_words=5000, vocab=100)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    gen = reader.prefetch(reader.iter_batches(str(path), 2, 256), depth=1)
+    next(gen)
+    gen.close()  # consumer abandons mid-stream
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(t.name == "ingest-prefetch" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    assert not any(t.name == "ingest-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
