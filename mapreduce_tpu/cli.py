@@ -31,8 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="mapreduce-tpu",
         description="TPU-native MapReduce word count (reference-parity CLI).",
     )
-    p.add_argument("input", nargs="?", default="test.txt",
-                   help="input text file (default: test.txt, matching the reference)")
+    p.add_argument("input", nargs="*", default=["test.txt"],
+                   help="input text file(s) (default: test.txt, matching the "
+                        "reference; multiple files stream as one corpus)")
     p.add_argument("--top-k", type=int, default=0,
                    help="report only the k most frequent words (0 = all)")
     p.add_argument("--chunk-bytes", type=int, default=1 << 20)
@@ -71,21 +72,22 @@ def _decode(words: list[bytes]) -> list[str]:
     return [w.decode("utf-8", errors="backslashreplace") for w in words]
 
 
-def _echo_file(path: str) -> None:
+def _echo_file(paths: list[str]) -> None:
     """Stream the input bytes to stdout (the reference's line echo,
-    main.cu:180) without materializing the file in memory."""
+    main.cu:180) without materializing the files in memory."""
     sys.stdout.write("Input Data:\n")
     sys.stdout.flush()
-    last = b"\n"
-    with open(path, "rb") as f:
-        while True:
-            block = f.read(1 << 20)
-            if not block:
-                break
-            sys.stdout.buffer.write(block)
-            last = block[-1:]
-    if last != b"\n":
-        sys.stdout.buffer.write(b"\n")
+    for path in paths:
+        last = b"\n"
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(1 << 20)
+                if not block:
+                    break
+                sys.stdout.buffer.write(block)
+                last = block[-1:]
+        if last != b"\n":
+            sys.stdout.buffer.write(b"\n")
     sys.stdout.buffer.flush()
 
 
@@ -94,14 +96,23 @@ def main(argv: list[str] | None = None) -> int:
 
     parser = build_parser()
     args = parser.parse_args(argv)
+    paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
-        # fopen failure, main.cu:174); stream mode never loads the whole file.
-        with open(args.input, "rb") as f:
-            data = None if args.stream else f.read()
-        input_bytes = os.path.getsize(args.input)
+        # fopen failure, main.cu:174); stream mode never loads the files.
+        chunks = []
+        input_bytes = 0
+        for path in paths:  # one pass so a failure blames the right file
+            input_bytes += os.path.getsize(path)
+            with open(path, "rb") as f:
+                if not args.stream:
+                    chunks.append(f.read())
+        # Non-stream, multi-file: files are independent token streams; join
+        # with a separator so no token merges across a file boundary.
+        data = None if args.stream else b"\n".join(chunks)
+        del chunks  # don't hold a second copy of the corpus for the run
     except OSError as e:
-        print(f"error: cannot read {args.input}: {e}", file=sys.stderr)
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
         return 2
 
     try:
@@ -122,7 +133,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.stream:
             from mapreduce_tpu.runtime.executor import count_file
 
-            result = count_file(args.input, config=config, top_k=args.top_k or None,
+            result = count_file(paths, config=config, top_k=args.top_k or None,
                                 distinct_sketch=args.distinct_sketch,
                                 checkpoint_path=args.checkpoint,
                                 checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
@@ -142,7 +153,7 @@ def main(argv: list[str] | None = None) -> int:
     display = _decode(words)
     if args.format == "reference":
         if not args.no_echo:
-            _echo_file(args.input)
+            _echo_file(paths)
         out.write("--------------------------\n")
         for w, c in zip(display, counts):
             out.write(f"{w}\t{c}\n")

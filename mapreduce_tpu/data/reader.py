@@ -18,6 +18,7 @@ reference would overflow a stack buffer in that case (``main.cu:184,199``).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Iterator
 
 import numpy as np
@@ -123,6 +124,67 @@ def iter_batches(path: str, n_shards: int, chunk_bytes: int,
         step += 1
 
 
+def iter_batches_multi(paths, n_shards: int, chunk_bytes: int,
+                       max_token_bytes: int = 4096, start_offset: int = 0,
+                       start_step: int = 0, use_native: bool = True,
+                       end_offset: int | None = None) -> Iterator[Batch]:
+    """Stream a MULTI-FILE corpus (real corpora — e.g. Common Crawl WET
+    shards, BASELINE.md — are many files) as one logical byte stream.
+
+    Offsets (``start_offset``/``end_offset``/``Batch.base_offsets``) are
+    *virtual*: positions in the concatenation of the files in order.  Files
+    are chunked independently — a file's end is a hard token boundary, so no
+    token ever spans two files and no join bytes are inserted.  Step
+    numbering continues across files (chunk ids stay globally unique).
+    """
+    if isinstance(paths, (str, bytes, os.PathLike)):
+        paths = [paths]
+    sizes = [_file_size(p) for p in paths]
+    step = start_step
+    file_start = 0
+    for path, size in zip(paths, sizes):
+        file_end = file_start + size
+        local_lo = max(0, start_offset - file_start)
+        local_hi = size if end_offset is None \
+            else min(size, max(0, end_offset - file_start))
+        if local_lo < local_hi:
+            for b in iter_batches(path, n_shards, chunk_bytes,
+                                  max_token_bytes=max_token_bytes,
+                                  start_offset=local_lo, start_step=step,
+                                  end_offset=local_hi, use_native=use_native):
+                yield Batch(data=b.data,
+                            base_offsets=b.base_offsets + file_start,
+                            lengths=b.lengths, step=b.step)
+                step = b.step + 1
+        file_start = file_end
+
+
+def read_words_at_multi(paths, spans: list[tuple[int, int]]) -> list[bytes]:
+    """Multi-file :func:`read_words_at`: spans use virtual corpus offsets."""
+    if isinstance(paths, (str, bytes, os.PathLike)):
+        return read_words_at(paths, spans)
+    if not spans:
+        return []
+    starts = np.cumsum([0] + [_file_size(p) for p in paths])
+    offs = np.asarray([s[0] for s in spans], dtype=np.int64)
+    file_idx = np.searchsorted(starts, offs, side="right") - 1
+    # Group spans by file with one argsort (not a per-file rescan).
+    order = np.argsort(file_idx, kind="stable")
+    out: list[bytes | None] = [None] * len(spans)
+    i = 0
+    while i < len(order):
+        k = int(file_idx[order[i]])
+        j = i
+        while j < len(order) and file_idx[order[j]] == k:
+            j += 1
+        group = order[i:j]
+        local = [(int(offs[g] - starts[k]), spans[g][1]) for g in group]
+        for g, word in zip(group, read_words_at(paths[k], local)):
+            out[g] = word
+        i = j
+    return out  # type: ignore[return-value]
+
+
 def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
     """Run an iterator in a background thread, ``depth`` items ahead.
 
@@ -175,8 +237,6 @@ def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
 
 
 def _file_size(path: str) -> int:
-    import os
-
     return os.path.getsize(path)
 
 

@@ -41,13 +41,21 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
     mid-run.  Table capacity is deliberately not in the dict: it is validated
     against the saved arrays' actual shape (ground truth) by the executor.
     """
-    size = os.path.getsize(input_path)
+    paths = [input_path] if isinstance(input_path, (str, bytes, os.PathLike)) \
+        else list(input_path)
+    multi = len(paths) > 1
+    size = 0
     h = hashlib.sha256()
-    with open(input_path, "rb") as f:
-        h.update(f.read(1 << 16))
-        if size > (1 << 16):
-            f.seek(max(0, size - (1 << 16)))
+    for p in paths:
+        psize = os.path.getsize(p)
+        size += psize
+        if multi:  # member boundaries matter; single-file stays bit-compatible
+            h.update(str(psize).encode())
+        with open(p, "rb") as f:
             h.update(f.read(1 << 16))
+            if psize > (1 << 16):
+                f.seek(max(0, psize - (1 << 16)))
+                h.update(f.read(1 << 16))
     return {"input_size": size, "input_hash": h.hexdigest(),
             "n_devices": n_devices, "chunk_bytes": chunk_bytes,
             "backend": backend,

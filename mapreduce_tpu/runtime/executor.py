@@ -76,7 +76,7 @@ def _rebuild_state(job, table: table_ops.CountTable, extras: dict,
     return SketchedState(table, extras["hll_registers"])
 
 
-def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
+def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
             logger=None, progress_every: int = 50,
@@ -180,7 +180,7 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     # Prefetch: host-side chunking of step N+1 overlaps device compute of
     # step N (the double-buffering of SURVEY §7 step 4).
     for batch in reader_mod.prefetch(
-            reader_mod.iter_batches(path, n_dev, config.chunk_bytes,
+            reader_mod.iter_batches_multi(path, n_dev, config.chunk_bytes,
                                     start_offset=start_offset,
                                     start_step=start_step,
                                     end_offset=range_hi)):
@@ -210,7 +210,7 @@ def run_job(job: MapReduceJob, path: str, config: Config = DEFAULT_CONFIG,
     return RunResult(value=value, metrics=m, bases=bases)
 
 
-def recover_from_file(tbl: table_ops.CountTable, path: str, bases: np.ndarray,
+def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
                       n_devices: int) -> WordCountResult:
     """Host-side string recovery for a streamed run.
 
@@ -228,7 +228,7 @@ def recover_from_file(tbl: table_ops.CountTable, path: str, bases: np.ndarray,
     absolute = bases[step, dev] + pos
     order = np.argsort(absolute, kind="stable")
     spans = [(int(absolute[i]), int(length[i])) for i in order]
-    words = reader_mod.read_words_at(path, spans)
+    words = reader_mod.read_words_at_multi(path, spans)
     dropped_uniques = int(np.asarray(tbl.dropped_uniques))
     return WordCountResult(
         words=words,
@@ -240,7 +240,7 @@ def recover_from_file(tbl: table_ops.CountTable, path: str, bases: np.ndarray,
     )
 
 
-def count_file(path: str, config: Config = DEFAULT_CONFIG, mesh=None,
+def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
                top_k: Optional[int] = None, distinct_sketch: bool = False,
                **kw) -> WordCountResult:
     """WordCount over a file via the streaming sharded pipeline.

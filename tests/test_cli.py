@@ -103,3 +103,16 @@ def test_max_token_bytes_flag_on_pallas_backend(tmp_path):
     obj = json.loads(r.stdout)
     assert obj["counts"] == [["short", 2]]
     assert obj["total"] == 3 and obj["dropped_count"] == 1
+
+
+def test_multiple_input_files(tmp_path):
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    a.write_text("x y x\n")
+    b.write_text("y z\n")
+    for extra in ([], ["--stream", "--chunk-bytes", "1024",
+                       "--table-capacity", "2048"]):
+        r = _run([str(a), str(b), "--format", "json", "--no-echo"] + extra)
+        assert r.returncode == 0, r.stderr
+        obj = json.loads(r.stdout)
+        assert dict(map(tuple, obj["counts"])) == {"x": 2, "y": 2, "z": 1}
+        assert obj["total"] == 5

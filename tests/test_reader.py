@@ -128,3 +128,56 @@ def test_prefetch_abandoned_consumer_stops_producer(tmp_path, rng):
         time.sleep(0.05)
     assert not any(t.name == "ingest-prefetch" and t.is_alive()
                    for t in threading.enumerate())
+
+
+def _write_files(tmp_path, blobs):
+    paths = []
+    for i, blob in enumerate(blobs):
+        p = tmp_path / f"part{i}.txt"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    return paths
+
+
+def test_multi_file_batches_cover_all_files(tmp_path, rng):
+    from tests.conftest import make_corpus
+
+    blobs = [make_corpus(rng, n_words=300, vocab=50) for _ in range(3)]
+    blobs[1] = blobs[1].rstrip() + b"tail-no-newline"  # no trailing separator
+    paths = _write_files(tmp_path, blobs)
+    total = 0
+    seen_bytes = bytearray()
+    for b in reader.iter_batches_multi(paths, 2, 256):
+        for row, base, ln in zip(b.data, b.base_offsets, b.lengths):
+            total += int(ln)
+            seen_bytes.extend(row[: int(ln)])
+    assert total == sum(len(b) for b in blobs)
+    assert bytes(seen_bytes) == b"".join(blobs)
+
+
+def test_multi_file_virtual_offsets_recover_words(tmp_path):
+    paths = _write_files(tmp_path, [b"alpha beta\n", b"gamma delta\n"])
+    # virtual offsets: gamma starts at 11 (after file 0's 11 bytes)
+    assert reader.read_words_at_multi(paths, [(0, 5), (11, 5), (17, 5)]) == \
+        [b"alpha", b"gamma", b"delta"]
+
+
+def test_multi_file_no_token_merge_at_file_boundary(tmp_path):
+    """'abc' at end of file 0 and 'def' at start of file 1 stay two tokens."""
+    paths = _write_files(tmp_path, [b"x abc", b"def y\n"])
+    got = {}
+    for b in reader.iter_batches_multi(paths, 1, 128):
+        for row, ln in zip(b.data, b.lengths):
+            for w in bytes(row[: int(ln)]).split():
+                got[w] = got.get(w, 0) + 1
+    assert got == {b"x": 1, b"abc": 1, b"def": 1, b"y": 1}
+
+
+def test_multi_file_start_and_end_offsets(tmp_path):
+    paths = _write_files(tmp_path, [b"aa bb \n", b"cc dd \n"])
+    words = []
+    for b in reader.iter_batches_multi(paths, 1, 128, start_offset=3,
+                                       end_offset=10):
+        for row, ln in zip(b.data, b.lengths):
+            words += bytes(row[: int(ln)]).split()
+    assert words == [b"bb", b"cc"]
