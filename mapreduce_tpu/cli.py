@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference; multiple files stream as one corpus)")
     p.add_argument("--top-k", type=int, default=0,
                    help="report only the k most frequent words (0 = all)")
+    p.add_argument("--ngram", type=int, default=1, metavar="N",
+                   help="count n-token grams instead of single words "
+                        "(reported entries are the exact source spans, e.g. "
+                        "'Hello World'; with --stream, grams never span "
+                        "chunk seams)")
     p.add_argument("--chunk-bytes", type=int, default=1 << 20)
     p.add_argument("--table-capacity", type=int, default=1 << 18)
     p.add_argument("--format", choices=("reference", "json", "tsv"), default="reference",
@@ -55,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: carry a HyperLogLog so the distinct "
                         "count stays accurate past table capacity "
                         "(distinct_estimate in json output)")
+    p.add_argument("--count-sketch", action="store_true",
+                   help="with --stream: carry a Count-Min sketch so any "
+                        "word's frequency stays queryable past table "
+                        "capacity (see --estimate)")
+    p.add_argument("--estimate", action="append", default=[], metavar="WORD",
+                   help="report the sketch-estimated count of WORD "
+                        "(repeatable; implies --count-sketch)")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -67,9 +79,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+_CTRL_ESCAPES = str.maketrans({"\t": "\\t", "\n": "\\n", "\r": "\\r",
+                               "\x00": "\\x00", "\x0b": "\\x0b", "\x0c": "\\x0c"})
+
+
 def _decode(words: list[bytes]) -> list[str]:
-    """Lossless-enough display decoding: distinct byte words stay distinct."""
-    return [w.decode("utf-8", errors="backslashreplace") for w in words]
+    """Lossless-enough display decoding: distinct byte words stay distinct.
+
+    Control separators are escaped so n-gram spans (which carry their real
+    inter-token separator bytes) keep report lines one-per-entry; single
+    words never contain separators, so reference byte-parity is unaffected.
+    """
+    return [w.decode("utf-8", errors="backslashreplace").translate(_CTRL_ESCAPES)
+            for w in words]
 
 
 def _echo_file(paths: list[str]) -> None:
@@ -96,6 +118,13 @@ def main(argv: list[str] | None = None) -> int:
 
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.ngram < 1:
+        parser.error(f"--ngram must be >= 1, got {args.ngram}")
+    if (args.count_sketch or args.estimate) and not args.stream:
+        parser.error("--count-sketch/--estimate require --stream")
+    if (args.count_sketch or args.estimate) and args.distinct_sketch:
+        parser.error("--count-sketch/--estimate and --distinct-sketch are "
+                     "mutually exclusive per run")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -135,12 +164,15 @@ def main(argv: list[str] | None = None) -> int:
 
             result = count_file(paths, config=config, top_k=args.top_k or None,
                                 distinct_sketch=args.distinct_sketch,
+                                count_sketch=args.count_sketch or bool(args.estimate),
+                                ngram=args.ngram,
                                 checkpoint_path=args.checkpoint,
                                 checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
         else:
             from mapreduce_tpu.models import wordcount
 
-            result = wordcount.count_words(data, config)
+            result = wordcount.count_ngrams(data, args.ngram, config) \
+                if args.ngram > 1 else wordcount.count_words(data, config)
     elapsed = time.perf_counter() - t0
 
     if args.top_k and not args.stream:  # stream mode already applied top-k
@@ -148,6 +180,9 @@ def main(argv: list[str] | None = None) -> int:
 
         result = apply_top_k(result, args.top_k)
     words, counts = result.words, result.counts
+
+    estimates = {w: result.estimate_count(w.encode()) for w in args.estimate} \
+        if result.cms is not None else {}
 
     out = sys.stdout
     display = _decode(words)
@@ -159,9 +194,13 @@ def main(argv: list[str] | None = None) -> int:
             out.write(f"{w}\t{c}\n")
         out.write("--------------------------\n")
         out.write(f"Total Count:{result.total}\n")
+        for w, e in estimates.items():
+            out.write(f"estimate:{w}\t{e}\n")
     elif args.format == "tsv":
         for w, c in zip(display, counts):
             out.write(f"{w}\t{c}\n")
+        for w, e in estimates.items():
+            out.write(f"estimate:{w}\t{e}\n")
     else:
         # "counts" is a list of pairs, not an object: distinct byte words must
         # stay distinct entries even if their display decodings collide.
@@ -174,6 +213,8 @@ def main(argv: list[str] | None = None) -> int:
         }
         if result.distinct_estimate is not None:
             payload["distinct_estimate"] = round(result.distinct_estimate, 1)
+        if estimates:
+            payload["estimates"] = estimates
         out.write(json.dumps(payload) + "\n")
 
     if args.stats:

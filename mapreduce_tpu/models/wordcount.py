@@ -44,9 +44,22 @@ class WordCountResult:
     distinct_estimate: float | None = None  # HLL estimate (~0.8% err @ p=14);
     #   populated by sketched runs — unlike ``distinct`` it stays accurate
     #   past table capacity
+    cms: np.ndarray | None = dataclasses.field(default=None, compare=False)
+    #   Count-Min sketch from a count_sketch run: estimate_count() answers
+    #   frequency queries for ANY word, including ones spilled past capacity
 
     def as_dict(self) -> dict[bytes, int]:
         return dict(zip(self.words, self.counts))
+
+    def estimate_count(self, word: bytes) -> int | None:
+        """CMS frequency estimate for ``word`` (None without a sketch).
+
+        Never under-estimates a word the run saw (within the batch-capacity
+        envelope); over-estimates by at most ~total/width per row w.h.p.
+        """
+        if self.cms is None:
+            return None
+        return sketch_ops.cms_query(self.cms, word)
 
 
 def apply_top_k(result: WordCountResult, k: int) -> WordCountResult:
@@ -130,6 +143,25 @@ def count_words(data: bytes, config: Config = DEFAULT_CONFIG) -> WordCountResult
     return recover_result(count_table(data, config), data)
 
 
+@functools.partial(jax.jit, static_argnames=("capacity", "n"))
+def _ngram_step(data: jax.Array, capacity: int, n: int) -> table_ops.CountTable:
+    stream = tok_ops.ngrams(tok_ops.tokenize(data), n)
+    return table_ops.from_stream(stream, capacity)
+
+
+def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCountResult:
+    """Exact n-gram counts for an in-memory buffer (see :class:`NGramCountJob`).
+
+    Reported "words" are the exact source spans of the grams (separators
+    between tokens included); ``total`` is the number of grams,
+    ``max(tokens - n + 1, 0)``.
+    """
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    padded = tok_ops.pad_to(buf, max(128, -(-buf.shape[0] // 128) * 128))
+    tbl = _ngram_step(jax.device_put(padded), config.table_capacity, n)
+    return recover_result(tbl, data)
+
+
 class WordCountJob:
     """WordCount as a :class:`mapreduce_tpu.parallel.mapreduce.MapReduceJob`.
 
@@ -172,6 +204,43 @@ class TopKWordCountJob(WordCountJob):
         return table_ops.top_k(state, self.k)
 
 
+class NGramCountJob(WordCountJob):
+    """Count n-token grams (bigrams, trigrams, ...) instead of single words.
+
+    A beyond-parity model family (the reference's map UDF emits only single
+    words, ``mapper`` ``main.cu:37-54``) that reuses the whole stack: the
+    gram stream rides the same CountTable / collective-merge / string-recovery
+    machinery, and each reported "word" is the exact source span of the gram
+    (inter-token separators included, e.g. ``b"Hello World"``).
+
+    Semantics envelope: grams are counted within each chunk's contiguous byte
+    range; a gram whose tokens straddle a chunk seam is not formed, so a
+    streamed run undercounts by at most ``(n-1) * (chunks - 1)`` grams versus
+    a single-buffer run.  With multi-MB chunks this is negligible; tests pin
+    the exact single-buffer semantics on a one-device mesh.
+
+    Tokenization uses the XLA segmented-scan backend: the gram pairing is a
+    carry-forward scan over the flat per-byte stream, which composes with
+    :func:`...ops.tokenize.tokenize` directly (the fused Pallas kernel's
+    split bulk/seam streams do not preserve the flat ordering pairing needs).
+    """
+
+    def __init__(self, n: int, config: Config = DEFAULT_CONFIG,
+                 top_k: int | None = None):
+        if n < 1:
+            raise ValueError(f"ngram order must be >= 1, got {n}")
+        super().__init__(config)
+        self.n = n
+        self.k = top_k
+
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
+        stream = tok_ops.ngrams(tok_ops.tokenize(chunk), self.n)
+        return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
+
+    def finalize(self, state):
+        return table_ops.top_k(state, self.k) if self.k else state
+
+
 class SketchedState(NamedTuple):
     """Count table + HyperLogLog registers (a pytree; engine/collective
     machinery treats it like any other mergeable accumulator)."""
@@ -180,42 +249,112 @@ class SketchedState(NamedTuple):
     registers: jax.Array  # uint32[2**p]
 
 
-class SketchedWordCountJob:
-    """Wrap any WordCount-family job with a distinct-count sketch.
+class FreqSketchedState(NamedTuple):
+    """Count table + Count-Min Sketch (a pytree)."""
 
-    The table's ``distinct`` degrades to an upper bound once keys spill past
-    capacity (see WordCountResult); the sketch keeps an accurate distinct
-    estimate at any scale.  Registers update from the *deduplicated* batch
-    table each step — a capacity-sized scatter-max, never a stream-sized one
-    (the TPU cost model: scatter cost scales with input length) — and merge
-    with elementwise ``maximum``, an idempotent monoid that rides the same
-    collectives as the table.
+    table: table_ops.CountTable
+    cms: jax.Array  # uint32[depth, width]
 
-    Envelope: the sketch sees the keys that survive per-chunk batch
-    extraction (``Config.batch_uniques`` distinct keys per chunk); a single
-    chunk holding more uniques than that spills the excess from table and
-    sketch alike.  Size batch capacity to per-chunk vocabulary as usual.
+
+class _SketchComposedJob:
+    """Compose any WordCount-family job with a mergeable sketch.
+
+    Shared TPU shape of all sketch families: the sketch updates from the
+    *deduplicated* per-chunk batch table (capacity-sized device ops, never
+    stream-sized), and merges with an associative+commutative monoid that
+    rides the same collectives as the table.  Envelope: tokens spilled past
+    per-chunk batch extraction miss the sketch too (accounted in
+    ``dropped_count``).
+
+    Subclasses set ``state_cls`` (a ``(table, sketch)`` NamedTuple) and the
+    three sketch ops.
     """
 
-    def __init__(self, base: WordCountJob, precision: int = sketch_ops.DEFAULT_PRECISION):
+    state_cls: type
+
+    def __init__(self, base: WordCountJob):
         self.base = base
         self.config = base.config
-        self.precision = precision
 
-    def init_state(self) -> SketchedState:
-        return SketchedState(self.base.init_state(), sketch_ops.empty(self.precision))
+    def _empty(self) -> jax.Array:
+        raise NotImplementedError
+
+    def _update(self, sk: jax.Array, update: table_ops.CountTable) -> jax.Array:
+        raise NotImplementedError
+
+    def _merge(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def init_state(self):
+        return self.state_cls(self.base.init_state(), self._empty())
 
     def map_chunk(self, chunk, chunk_id) -> table_ops.CountTable:
         return self.base.map_chunk(chunk, chunk_id)
 
-    def combine(self, state: SketchedState, update: table_ops.CountTable) -> SketchedState:
-        regs = sketch_ops.update_from_keys(
-            state.registers, update.key_hi, update.key_lo, update.count > 0)
-        return SketchedState(self.base.combine(state.table, update), regs)
+    def combine(self, state, update: table_ops.CountTable):
+        return self.state_cls(self.base.combine(state[0], update),
+                              self._update(state[1], update))
 
-    def merge(self, a: SketchedState, b: SketchedState) -> SketchedState:
-        return SketchedState(self.base.merge(a.table, b.table),
-                             sketch_ops.merge(a.registers, b.registers))
+    def merge(self, a, b):
+        return self.state_cls(self.base.merge(a[0], b[0]),
+                              self._merge(a[1], b[1]))
 
-    def finalize(self, state: SketchedState) -> SketchedState:
-        return SketchedState(self.base.finalize(state.table), state.registers)
+    def finalize(self, state):
+        return self.state_cls(self.base.finalize(state[0]), state[1])
+
+
+class FreqSketchedWordCountJob(_SketchComposedJob):
+    """Wrap any WordCount-family job with a Count-Min frequency sketch.
+
+    Where :class:`SketchedWordCountJob` keeps the *distinct count* honest past
+    table capacity, this keeps *per-word frequencies* queryable: the sketch's
+    row-min upper-bounds any key's true count (error <= total/width per row
+    w.h.p.), including words the exact table spilled.  Query host-side with
+    :func:`mapreduce_tpu.ops.sketch.cms_query` — any word (or n-gram span),
+    no device trip.
+    """
+
+    state_cls = FreqSketchedState
+
+    def __init__(self, base: WordCountJob, depth: int = sketch_ops.CMS_DEPTH,
+                 width_log2: int = sketch_ops.CMS_WIDTH_LOG2):
+        super().__init__(base)
+        self.depth = depth
+        self.width_log2 = width_log2
+
+    def _empty(self):
+        return sketch_ops.cms_empty(self.depth, self.width_log2)
+
+    def _update(self, sk, update):
+        return sketch_ops.cms_update(sk, update.key_hi, update.key_lo, update.count)
+
+    def _merge(self, a, b):
+        return sketch_ops.cms_merge(a, b)
+
+
+class SketchedWordCountJob(_SketchComposedJob):
+    """Wrap any WordCount-family job with a distinct-count sketch.
+
+    The table's ``distinct`` degrades to an upper bound once keys spill past
+    capacity (see WordCountResult); the HyperLogLog keeps an accurate
+    distinct estimate at any scale.  Register updates are a capacity-sized
+    scatter-max (the TPU cost model: scatter cost scales with input length);
+    the merge is elementwise ``maximum``, idempotent, so cross-chunk
+    duplicate keys are harmless.
+    """
+
+    state_cls = SketchedState
+
+    def __init__(self, base: WordCountJob, precision: int = sketch_ops.DEFAULT_PRECISION):
+        super().__init__(base)
+        self.precision = precision
+
+    def _empty(self):
+        return sketch_ops.empty(self.precision)
+
+    def _update(self, sk, update):
+        return sketch_ops.update_from_keys(
+            sk, update.key_hi, update.key_lo, update.count > 0)
+
+    def _merge(self, a, b):
+        return sketch_ops.merge(a, b)

@@ -84,3 +84,151 @@ def estimate(registers: np.ndarray | jax.Array) -> float:
     if raw <= 2.5 * m and zeros:
         return float(m * np.log(m / zeros))  # linear counting, small range
     return float(raw)
+
+
+# --- Count-Min Sketch --------------------------------------------------------
+# The exact CountTable answers "how often did word w occur" only for the words
+# it retained; past capacity, spilled words vanish into dropped_* scalars.
+# The CMS closes the *frequency* gap the way HLL closes the distinct-count
+# gap: a (depth x width) uint32 matrix whose row-wise min upper-bounds any
+# key's true count, with error <= total/width per row w.h.p.  Like the HLL,
+# it updates from the deduplicated per-chunk batch table (depth
+# capacity-sized scatter-adds, never stream-sized), and merges by elementwise
+# addition — associative + commutative, riding the same collectives.
+
+CMS_DEPTH = 4
+CMS_WIDTH_LOG2 = 16  # 4 x 64K x uint32 = 1 MiB of state
+
+# Odd row salts (xxhash/murmur-family primes) making the per-row bucket
+# hashes effectively independent.
+_CMS_SALTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F,
+              0x165667B1, 0xFD7046C5, 0xB55A4F09, 0x2127599B)
+
+
+def cms_empty(depth: int = CMS_DEPTH, width_log2: int = CMS_WIDTH_LOG2) -> jax.Array:
+    """Zeroed sketch, uint32[depth, 2**width_log2]."""
+    if not 1 <= depth <= len(_CMS_SALTS):
+        raise ValueError(f"depth must be in [1, {len(_CMS_SALTS)}], got {depth}")
+    if not 8 <= width_log2 <= 24:
+        raise ValueError(f"width_log2 must be in [8, 24], got {width_log2}")
+    return jnp.zeros((depth, 1 << width_log2), dtype=jnp.uint32)
+
+
+def _fmix32_jnp(x: jax.Array) -> jax.Array:
+    x = x ^ (x >> 16)
+    x = x * constants.FMIX_C1
+    x = x ^ (x >> 13)
+    x = x * constants.FMIX_C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def _cms_bucket_jnp(key_hi: jax.Array, key_lo: jax.Array, row: int,
+                    width_mask: int) -> jax.Array:
+    h = _fmix32_jnp((key_hi ^ jnp.uint32(_CMS_SALTS[row])) * constants.FMIX_C1
+                    + key_lo * constants.FMIX_C2 + jnp.uint32(row))
+    return (h & jnp.uint32(width_mask)).astype(jnp.int32)
+
+
+def cms_update(cms: jax.Array, key_hi: jax.Array, key_lo: jax.Array,
+               counts: jax.Array) -> jax.Array:
+    """Add a batch of (key, count) rows into the sketch.
+
+    Empty table slots carry count 0, so no validity mask is needed: adding
+    zero to an arbitrary bucket is a no-op.
+    """
+    depth, width = cms.shape
+    out = cms
+    for r in range(depth):  # depth is static and small: unrolled scatters
+        bucket = _cms_bucket_jnp(key_hi, key_lo, r, width - 1)
+        out = out.at[r, bucket].add(counts.astype(jnp.uint32), mode="drop")
+    return out
+
+
+def cms_merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Associative, commutative sketch merge."""
+    return a + b
+
+
+# Host-side mirrors (python-int arithmetic, masked to 32 bits) so any word —
+# retained or spilled — can be queried after the run without a device trip.
+
+_M32 = 0xFFFFFFFF
+
+
+def _fmix32_host(x: int) -> int:
+    x ^= x >> 16
+    x = (x * int(constants.FMIX_C1)) & _M32
+    x ^= x >> 13
+    x = (x * int(constants.FMIX_C2)) & _M32
+    x ^= x >> 16
+    return x
+
+
+def _clamp_sentinel(key_hi: int, key_lo: int) -> tuple[int, int]:
+    if key_hi == int(constants.SENTINEL_KEY) and key_lo == int(constants.SENTINEL_KEY):
+        key_lo = (key_lo - 1) & _M32
+    return key_hi, key_lo
+
+
+def _hash_token(token: bytes) -> tuple[int, int]:
+    v1 = v2 = 0
+    for c in token:
+        v1 = (v1 * int(constants.HASH_BASE_1) + c + 1) & _M32
+        v2 = (v2 * int(constants.HASH_BASE_2) + c + 1) & _M32
+    n = len(token)
+    return _clamp_sentinel(_fmix32_host(v1 ^ (n & _M32)),
+                           _fmix32_host((v2 + 0x9E3779B9 * n) & _M32))
+
+
+def hash_word(word: bytes) -> tuple[int, int]:
+    """The device 64-bit key for ``word`` — a single token OR an n-gram span
+    (host mirror).
+
+    A ``word`` containing separator bytes is keyed the way the device keys
+    grams: per-token rolling-hash + fmix (mirroring
+    :func:`mapreduce_tpu.ops.tokenize.tokenize`), folded left-to-right with
+    the gram carry-mix (mirroring ``_extend_grams``).  The device never emits
+    a *token* containing a separator, so the interpretations cannot collide.
+    Pinned to the device hashes by
+    ``tests/test_sketch.py::test_hash_word_matches_device`` (tokens) and
+    ``test_hash_word_matches_device_grams`` (spans).
+    """
+    seps = bytes(constants.SEPARATOR_BYTES)
+    tokens, cur = [], bytearray()
+    for c in word:
+        if c in seps:
+            if cur:
+                tokens.append(bytes(cur))
+                cur = bytearray()
+        else:
+            cur.append(c)
+    if cur:
+        tokens.append(bytes(cur))
+    if not tokens:
+        return _hash_token(b"")
+    key_hi, key_lo = _hash_token(tokens[0])
+    for tok in tokens[1:]:
+        t_hi, t_lo = _hash_token(tok)
+        key_hi, key_lo = _clamp_sentinel(
+            _fmix32_host(((key_hi * int(constants.HASH_BASE_1)) & _M32) ^ t_hi),
+            _fmix32_host(((key_lo * int(constants.HASH_BASE_2)) & _M32) ^ t_lo))
+    return key_hi, key_lo
+
+
+def cms_query(cms: np.ndarray, word: bytes) -> int:
+    """Estimated occurrence count of ``word``: min over rows (host-side).
+
+    Never under-estimates a word the sketch saw; over-estimates by at most
+    ~total/width per row with probability 1 - 2**-depth.
+    """
+    sk = np.asarray(cms)
+    depth, width = sk.shape
+    key_hi, key_lo = hash_word(word)
+    est = None
+    for r in range(depth):
+        h = _fmix32_host(((key_hi ^ _CMS_SALTS[r]) * int(constants.FMIX_C1)
+                          + key_lo * int(constants.FMIX_C2) + r) & _M32)
+        v = int(sk[r, h & (width - 1)])
+        est = v if est is None else min(est, v)
+    return est

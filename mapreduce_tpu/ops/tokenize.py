@@ -151,6 +151,86 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0) -> TokenStream:
     )
 
 
+def _last_valid_combine(a, b):
+    """Associative combine: rightmost valid element wins (carry-forward)."""
+    a_v, a_hi, a_lo, a_pos = a
+    b_v, b_hi, b_lo, b_pos = b
+    return (
+        a_v | b_v,
+        jnp.where(b_v, b_hi, a_hi),
+        jnp.where(b_v, b_lo, a_lo),
+        jnp.where(b_v, b_pos, a_pos),
+    )
+
+
+def _extend_grams(gram: TokenStream, tokens: TokenStream) -> TokenStream:
+    """One pairing step: (k)-gram stream = (k-1)-gram stream x token stream.
+
+    For every token end, the (k-1)-gram ending at the *previous* token is
+    found with a carry-forward associative scan over the gram stream (the
+    bytes of the current token cannot hold a gram end, so "last gram end
+    before this position" is exactly "gram ending at the previous token").
+    The pairing is order-sensitive: the carried key is multiplied by an odd
+    base (bijective) before mixing in the current token's key.
+    """
+    valid = gram.count > 0
+    inc = jax.lax.associative_scan(
+        _last_valid_combine, (valid, gram.key_hi, gram.key_lo, gram.pos))
+
+    # Exclusive variant: shift the inclusive result right one position, so a
+    # gram ending AT p never pairs with itself.
+    def shift(x, fill):
+        return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+    c_valid = shift(inc[0], False)
+    c_hi = shift(inc[1], jnp.uint32(0))
+    c_lo = shift(inc[2], jnp.uint32(0))
+    c_pos = shift(inc[3], jnp.uint32(constants.POS_INF))
+
+    is_end = (tokens.count > 0) & c_valid
+    key_hi = _fmix32(c_hi * jnp.uint32(constants.HASH_BASE_1) ^ tokens.key_hi)
+    key_lo = _fmix32(c_lo * jnp.uint32(constants.HASH_BASE_2) ^ tokens.key_lo)
+
+    sentinel = jnp.uint32(constants.SENTINEL_KEY)
+    at_sentinel = (key_hi == sentinel) & (key_lo == sentinel)
+    key_lo = jnp.where(at_sentinel, key_lo - jnp.uint32(1), key_lo)
+
+    # Span = first byte of the gram's first token .. last byte of the current
+    # token (separator bytes in between included), so host string recovery
+    # reads the exact source text of the gram.
+    length = tokens.pos + tokens.length - c_pos
+    return TokenStream(
+        key_hi=jnp.where(is_end, key_hi, sentinel),
+        key_lo=jnp.where(is_end, key_lo, sentinel),
+        count=is_end.astype(jnp.uint32),
+        pos=jnp.where(is_end, c_pos, jnp.uint32(constants.POS_INF)),
+        length=jnp.where(is_end, length, jnp.uint32(0)),
+    )
+
+
+def ngrams(stream: TokenStream, n: int) -> TokenStream:
+    """Derive the n-token-gram stream from a token stream (n >= 1).
+
+    Each emission is keyed by an order-sensitive 64-bit hash of its n
+    consecutive tokens and carries the byte span from the first token's first
+    byte through the last token's last byte — so the host recovers the exact
+    source text (inter-word separators included) the same way it recovers
+    single words.  Grams never span chunk rows: each chunk's first n-1 tokens
+    start no gram, matching the per-chunk envelope documented by
+    :class:`mapreduce_tpu.models.wordcount.NGramCountJob`.
+
+    The reference has no n-gram capability (its map UDF emits single words
+    only, ``mapper`` ``main.cu:37-54``); this is a beyond-parity model family
+    riding the same tokenize -> table -> collective machinery.
+    """
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    gram = stream
+    for _ in range(n - 1):
+        gram = _extend_grams(gram, stream)
+    return gram
+
+
 def token_count(data: jax.Array) -> jax.Array:
     """Total number of tokens in a flat uint8 buffer (uint32 scalar)."""
     sep = separator_mask(data)

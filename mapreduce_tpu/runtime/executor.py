@@ -26,7 +26,9 @@ import numpy as np
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
 from mapreduce_tpu.data import reader as reader_mod
 from mapreduce_tpu.models.wordcount import (WordCountJob, TopKWordCountJob,
+                                            NGramCountJob,
                                             SketchedState, SketchedWordCountJob,
+                                            FreqSketchedState, FreqSketchedWordCountJob,
                                             WordCountResult, apply_top_k)
 from mapreduce_tpu.ops import table as table_ops
 from mapreduce_tpu.parallel.mapreduce import Engine, MapReduceJob
@@ -52,7 +54,13 @@ def _split_state(state_host) -> tuple[Optional[table_ops.CountTable], Optional[d
         return state_host, None
     if isinstance(state_host, SketchedState):
         return state_host.table, {"hll_registers": np.asarray(state_host.registers)}
+    if isinstance(state_host, FreqSketchedState):
+        return state_host.table, {"cms": np.asarray(state_host.cms)}
     return None, None
+
+
+_SKETCH_KINDS = (("hll_registers", SketchedWordCountJob, "--distinct-sketch"),
+                 ("cms", FreqSketchedWordCountJob, "--count-sketch"))
 
 
 def _rebuild_state(job, table: table_ops.CountTable, extras: dict,
@@ -63,17 +71,20 @@ def _rebuild_state(job, table: table_ops.CountTable, extras: dict,
     job disagree about the state structure (e.g. a --distinct-sketch run
     resuming a plain run's checkpoint, or vice versa): resuming would either
     crash mid-trace or silently drop the sketch."""
-    sketched_job = isinstance(job, SketchedWordCountJob)
-    sketched_ckpt = "hll_registers" in extras
-    if sketched_job != sketched_ckpt:
+    job_kind = next((k for k, cls, _ in _SKETCH_KINDS if isinstance(job, cls)), None)
+    ckpt_kind = next((k for k, _, _ in _SKETCH_KINDS if k in extras), None)
+    if job_kind != ckpt_kind:
+        def name(kind):
+            return next((flag for k, _, flag in _SKETCH_KINDS if k == kind), "no sketch")
         raise ckpt_mod.CheckpointMismatch(
-            f"checkpoint {checkpoint_path} was written "
-            f"{'with' if sketched_ckpt else 'without'} a distinct sketch, but "
-            f"this run is {'' if sketched_job else 'not '}sketched; delete "
-            f"the checkpoint or rerun with the original configuration")
-    if not sketched_job:
+            f"checkpoint {checkpoint_path} was written with {name(ckpt_kind)} "
+            f"state but this run uses {name(job_kind)}; delete the checkpoint "
+            f"or rerun with the original configuration")
+    if job_kind is None:
         return table
-    return SketchedState(table, extras["hll_registers"])
+    if job_kind == "hll_registers":
+        return SketchedState(table, extras["hll_registers"])
+    return FreqSketchedState(table, extras["cms"])
 
 
 def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
@@ -242,7 +253,7 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
 
 def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
                top_k: Optional[int] = None, distinct_sketch: bool = False,
-               **kw) -> WordCountResult:
+               count_sketch: bool = False, ngram: int = 1, **kw) -> WordCountResult:
     """WordCount over a file via the streaming sharded pipeline.
 
     ``distinct_sketch`` composes a HyperLogLog over the run, populating
@@ -250,21 +261,43 @@ def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
     spill past table capacity.  Sketched runs checkpoint like plain ones
     (the registers ride snapshots as extras); resuming a checkpoint across
     sketched/unsketched configurations raises CheckpointMismatch.
+
+    ``count_sketch`` composes a Count-Min sketch instead, populating
+    ``result.cms`` so ``result.estimate_count(word)`` answers frequency
+    queries for any word — including ones the exact table spilled.  The two
+    sketches are mutually exclusive per run (their states checkpoint
+    differently); pick the one matching the question being asked.
+
+    ``ngram > 1`` counts n-token grams instead of single words (per-chunk
+    gram semantics; see :class:`...models.wordcount.NGramCountJob`).
     """
+    if distinct_sketch and count_sketch:
+        raise ValueError("distinct_sketch and count_sketch are mutually "
+                         "exclusive per run; run twice to get both")
     mesh = mesh if mesh is not None else data_mesh()
-    job = TopKWordCountJob(top_k, config) if top_k else WordCountJob(config)
+    if ngram > 1:
+        job = NGramCountJob(ngram, config, top_k=top_k or None)
+    else:
+        job = TopKWordCountJob(top_k, config) if top_k else WordCountJob(config)
     if distinct_sketch:
         job = SketchedWordCountJob(job)
+    elif count_sketch:
+        job = FreqSketchedWordCountJob(job)
     rr = run_job(job, path, config=config, mesh=mesh, **kw)
     n_dev = mesh.size
-    value, registers = (rr.value.table, rr.value.registers) \
-        if isinstance(rr.value, SketchedState) else (rr.value, None)
+    value, registers, cms = rr.value, None, None
+    if isinstance(value, SketchedState):
+        value, registers = value.table, value.registers
+    elif isinstance(value, FreqSketchedState):
+        value, cms = value.table, np.asarray(value.cms)
     result = recover_from_file(value, path, rr.bases, n_dev)
     if registers is not None:
         from mapreduce_tpu.ops import sketch as sketch_ops
 
         result = dataclasses.replace(
             result, distinct_estimate=sketch_ops.estimate(registers))
+    if cms is not None:
+        result = dataclasses.replace(result, cms=cms)
     if top_k:
         result = apply_top_k(result, top_k)
     return result

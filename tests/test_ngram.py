@@ -1,0 +1,128 @@
+"""N-gram counting: device results vs. a pure-Python oracle.
+
+The reference has no n-gram capability (its map UDF emits single words only,
+``mapper`` ``main.cu:37-54``); this family is beyond-parity, so the oracle is
+the standard definition: sliding windows of n consecutive tokens of the
+whitespace-split stream, keyed by the exact source span (separators between
+tokens included).
+"""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.utils import oracle
+
+
+def ngram_oracle(data: bytes, n: int) -> dict[bytes, int]:
+    """{first-occurrence source span: count} per distinct n-token window.
+
+    Grams are keyed by their *token sequence* (the device semantics: the gram
+    hash mixes the token hashes, not the separator bytes between them), and
+    each is displayed as the source span of its first occurrence — so
+    ``b"w1 w1"`` and ``b"w1\\tw1"`` are the same bigram, reported under
+    whichever span came first.
+    """
+    # Token spans (start, end) in order, replicating oracle.split_words.
+    spans = []
+    start = None
+    seps = bytes(__import__("mapreduce_tpu").constants.SEPARATOR_BYTES)
+    for i, b in enumerate(data):
+        if b in seps:
+            if start is not None:
+                spans.append((start, i))
+                start = None
+        elif start is None:
+            start = i
+    if start is not None:
+        spans.append((start, len(data)))
+    counts: dict[tuple, int] = {}
+    first_span: dict[tuple, bytes] = {}
+    for i in range(len(spans) - n + 1):
+        window = spans[i: i + n]
+        key = tuple(data[s:e] for s, e in window)
+        counts[key] = counts.get(key, 0) + 1
+        first_span.setdefault(key, data[window[0][0]: window[-1][1]])
+    return {first_span[k]: c for k, c in counts.items()}
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_ngrams_match_oracle(small_corpus, n):
+    cfg = Config(table_capacity=1 << 14)
+    result = wordcount.count_ngrams(small_corpus, n, cfg)
+    expected = ngram_oracle(small_corpus, n)
+    assert result.as_dict() == expected
+    assert result.total == sum(expected.values())
+    assert result.dropped_count == 0
+
+
+def test_bigram_fixture(fixture_text):
+    result = wordcount.count_ngrams(fixture_text, 2)
+    expected = ngram_oracle(fixture_text, 2)
+    # 9 tokens -> 8 bigrams, all distinct except none repeat in the fixture.
+    assert result.total == 8
+    assert result.as_dict() == expected
+    # Spans carry the real separator bytes (here the fixture's spaces and
+    # newlines), e.g. the first bigram is the literal source text.
+    assert result.words[0] == b"Hello World"
+
+
+def test_unigram_order_matches_wordcount(fixture_text):
+    uni = wordcount.count_ngrams(fixture_text, 1)
+    base = wordcount.count_words(fixture_text)
+    assert uni.as_dict() == base.as_dict()
+
+
+def test_total_grams_is_tokens_minus_n_plus_1(small_corpus):
+    tokens = oracle.total_count(small_corpus)
+    for n in (1, 2, 3):
+        result = wordcount.count_ngrams(small_corpus, n, Config(table_capacity=1 << 14))
+        assert result.total == max(tokens - n + 1, 0)
+
+
+def test_order_sensitive_keys():
+    r = wordcount.count_ngrams(b"a b b a", 2)
+    # 'a b', 'b b', 'b a' — order matters, all three distinct.
+    assert r.as_dict() == {b"a b": 1, b"b b": 1, b"b a": 1}
+
+
+def test_fewer_tokens_than_n():
+    r = wordcount.count_ngrams(b"only two", 3)
+    assert r.total == 0
+    assert r.words == []
+
+
+def test_streamed_ngrams_single_device(tmp_path, small_corpus):
+    """On a one-device mesh a streamed run still splits the corpus into
+    chunks, so grams at seams are dropped — but within the documented
+    envelope: undercount <= (n-1) * (chunks - 1)."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=2048, table_capacity=1 << 14, backend="xla")
+    mesh = data_mesh(1)
+    result = count_file(str(path), config=cfg, mesh=mesh, ngram=2)
+    exact = ngram_oracle(small_corpus, 2)
+    n_chunks = -(-len(small_corpus) // 2048)
+    assert sum(exact.values()) - (n_chunks - 1) <= result.total <= sum(exact.values())
+    # Every reported gram + count is a true (within-chunk) gram occurrence.
+    for gram, count in result.as_dict().items():
+        assert exact.get(gram, 0) >= count
+
+
+def test_streamed_ngrams_multi_device(tmp_path, small_corpus):
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024, table_capacity=1 << 14, backend="xla")
+    result = count_file(str(path), config=cfg, mesh=data_mesh(8), ngram=2,
+                        top_k=10)
+    exact = ngram_oracle(small_corpus, 2)
+    assert len(result.words) == 10
+    for gram, count in result.as_dict().items():
+        assert exact.get(gram, 0) >= count
