@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--estimate", action="append", default=[], metavar="WORD",
                    help="report the sketch-estimated count of WORD "
                         "(repeatable; implies --count-sketch)")
+    p.add_argument("--grep", default=None, metavar="PATTERN",
+                   help="count occurrences of PATTERN instead of words "
+                        "(overlapping matches + matching lines; composes "
+                        "with --stream for sharded corpora)")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -113,6 +117,41 @@ def _echo_file(paths: list[str]) -> None:
     sys.stdout.buffer.flush()
 
 
+def _grep_main(args, paths, data, config, input_bytes: int) -> int:
+    """--grep mode: pattern counts instead of word counts."""
+    from mapreduce_tpu.models import grep
+
+    from mapreduce_tpu.runtime import profiling
+
+    pattern = args.grep.encode()
+    t0 = time.perf_counter()
+    try:
+        with profiling.trace(args.profile):
+            if args.stream:
+                result = grep.grep_file(paths, pattern, config=config)
+            else:
+                result = grep.grep_bytes(data, pattern, config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    out = sys.stdout
+    if args.format == "json":
+        out.write(json.dumps({"pattern": args.grep, "matches": result.matches,
+                              "lines": result.lines}) + "\n")
+    elif args.format == "tsv":
+        out.write(f"matches\t{result.matches}\nlines\t{result.lines}\n")
+    else:
+        out.write(f"Matches:{result.matches}\n")
+        out.write(f"Matching Lines:{result.lines}\n")
+    if args.stats:
+        gb = input_bytes / 1e9
+        print(f"[stats] {input_bytes} bytes, {result.matches} matches, "
+              f"{elapsed:.3f}s, {gb / elapsed:.3f} GB/s", file=sys.stderr)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     import os
 
@@ -125,6 +164,10 @@ def main(argv: list[str] | None = None) -> int:
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
+    if args.grep is not None and args.checkpoint:
+        # Honest failure beats a flag silently ignored: grep's scalar state
+        # has no snapshot format yet (the checkpoint layout is table-shaped).
+        parser.error("--checkpoint is not supported with --grep")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -156,6 +199,9 @@ def main(argv: list[str] | None = None) -> int:
     # Persistent XLA compile cache (multi-minute first compiles otherwise;
     # MAPREDUCE_COMPILE_CACHE overrides the location, empty disables).
     profiling.enable_compile_cache()
+
+    if args.grep is not None:
+        return _grep_main(args, paths, data, config, input_bytes)
 
     t0 = time.perf_counter()
     with profiling.trace(args.profile):

@@ -1,0 +1,169 @@
+"""Distributed grep: count occurrences / matching lines of a fixed pattern.
+
+A third model family (after word count and the sketches) riding the same
+Engine/collective machinery.  The reference has nothing comparable — its only
+workload is word count (``main.cu``) — but pattern search is *the* canonical
+MapReduce companion workload, and it exercises a different accumulator shape:
+tiny scalar states instead of capacity-sized tables, so the collective merge
+is a pure ``psum``-style reduction.
+
+TPU formulation: for a pattern of m bytes, the match mask over a chunk is the
+AND of m shifted byte-equality planes — static shapes, no data-dependent
+control flow, fully fused by XLA into one elementwise pass over the chunk.
+Matching-*line* counting reuses the tokenizer's segmented-scan trick with
+newline as the reset class: a match's line has counted it iff an earlier
+match shares the line, computed by an exclusive segmented prefix-OR.
+
+Envelope (documented, tested):
+  * occurrences are **overlapping** (pattern ``aa`` occurs twice in ``aaa``);
+  * a pattern containing separator bytes never matches across a chunk seam
+    (the reader cuts at separators), mirroring the n-gram per-chunk envelope;
+  * a logical line split across two chunk rows may count as matching in each
+    row, so ``lines`` is exact within rows and an upper bound across them
+    (off by at most chunks - 1);
+  * accumulators are 64-bit (uint32 lo/hi pairs with explicit carry — JAX
+    default-x64 is off, so device uint64 is unavailable): counts stay exact
+    past 2**32 occurrences, where a single uint32 would silently wrap on
+    corpus-scale single-byte patterns.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.parallel.mapreduce import MapReduceJob
+
+
+class GrepState(NamedTuple):
+    """Scalar accumulators (a pytree; merged by 64-bit carry addition)."""
+
+    matches_lo: jax.Array  # uint32: overlapping occurrences, low word
+    matches_hi: jax.Array  # uint32: high word
+    lines_lo: jax.Array  # uint32: lines containing >= 1 occurrence, low word
+    lines_hi: jax.Array  # uint32: high word
+
+
+def _add64(a_lo, a_hi, b_lo, b_hi):
+    """(lo, hi) + (lo, hi) with carry: exact uint64 in two uint32 lanes."""
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(jnp.uint32)
+    return lo, a_hi + b_hi + carry
+
+
+def _match_mask(chunk: jax.Array, pattern: np.ndarray) -> jax.Array:
+    """bool[n]: True where an occurrence of ``pattern`` starts."""
+    m, n = pattern.shape[0], chunk.shape[0]
+    if m > n:
+        return jnp.zeros((n,), jnp.bool_)
+    hit = jnp.ones((n - m + 1,), jnp.bool_)
+    for i, b in enumerate(pattern.tolist()):  # m is static: unrolled ANDs
+        hit = hit & (chunk[i: n - m + 1 + i] == jnp.uint8(b))
+    return jnp.concatenate([hit, jnp.zeros((m - 1,), jnp.bool_)]) if m > 1 else hit
+
+
+def _or_reset_combine(a, b):
+    """Segmented prefix-OR: (reset, value); reset discards the left prefix."""
+    a_f, a_v = a
+    b_f, b_v = b
+    return (a_f | b_f, jnp.where(b_f, b_v, a_v | b_v))
+
+
+def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
+    """One chunk's (occurrences, matching lines), as a GrepState."""
+    hit = _match_mask(chunk, pattern)
+    newline = chunk == jnp.uint8(0x0A)
+    # Exclusive segmented prefix-OR of `hit` with newline resets: True where
+    # an earlier position in the SAME line already matched.
+    _, inc = jax.lax.associative_scan(_or_reset_combine, (newline, hit))
+    seen_before = jnp.concatenate([jnp.zeros((1,), jnp.bool_), inc[:-1]])
+    # (a newline position itself resets, so inc at the newline is False for
+    # the next line's first position after the shift — line state never leaks)
+    first_in_line = hit & ~seen_before
+    zero = jnp.zeros((), jnp.uint32)
+    # Per-chunk sums fit uint32 by construction (a chunk holds < 2**32 bytes).
+    return GrepState(matches_lo=jnp.sum(hit).astype(jnp.uint32), matches_hi=zero,
+                     lines_lo=jnp.sum(first_in_line).astype(jnp.uint32), lines_hi=zero)
+
+
+class GrepJob(MapReduceJob):
+    """Pattern-occurrence counting as a :class:`MapReduceJob`.
+
+    The accumulator is four uint32 scalars, so the global reduction is the
+    degenerate (and fastest) case of the collective tree-merge: effectively
+    a ``psum`` over the mesh.
+    """
+
+    def __init__(self, pattern: bytes):
+        if not pattern:
+            raise ValueError("grep pattern must be non-empty")
+        if len(pattern) > 256:
+            raise ValueError(f"grep pattern of {len(pattern)} bytes exceeds "
+                             "the 256-byte limit (the match mask unrolls one "
+                             "fused comparison per pattern byte)")
+        self.pattern = np.frombuffer(pattern, dtype=np.uint8)
+
+    def init_state(self) -> GrepState:
+        zero = jnp.zeros((), jnp.uint32)
+        return GrepState(zero, zero, zero, zero)
+
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepState:
+        return count_matches_in_chunk(chunk, self.pattern)
+
+    def combine(self, state: GrepState, update: GrepState) -> GrepState:
+        m_lo, m_hi = _add64(state.matches_lo, state.matches_hi,
+                            update.matches_lo, update.matches_hi)
+        l_lo, l_hi = _add64(state.lines_lo, state.lines_hi,
+                            update.lines_lo, update.lines_hi)
+        return GrepState(m_lo, m_hi, l_lo, l_hi)
+
+    def merge(self, a: GrepState, b: GrepState) -> GrepState:
+        return self.combine(a, b)
+
+
+class GrepResult(NamedTuple):
+    """Host-side result."""
+
+    pattern: bytes
+    matches: int  # overlapping occurrences
+    lines: int  # matching lines (exact within chunks; see module envelope)
+
+
+def _state_result(pattern: bytes, state) -> GrepResult:
+    lo, hi = int(np.asarray(state.matches_lo)), int(np.asarray(state.matches_hi))
+    llo, lhi = int(np.asarray(state.lines_lo)), int(np.asarray(state.lines_hi))
+    return GrepResult(pattern, (hi << 32) | lo, (lhi << 32) | llo)
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_counter(pattern: bytes):
+    """One compiled counter per pattern (jit caches per buffer shape)."""
+    pat = np.frombuffer(pattern, dtype=np.uint8)
+    return jax.jit(lambda c: count_matches_in_chunk(c, pat))
+
+
+def grep_bytes(data: bytes, pattern: bytes, config: Config = DEFAULT_CONFIG) -> GrepResult:
+    """One-call API: pattern counts for an in-memory buffer."""
+    from mapreduce_tpu.ops import tokenize as tok_ops
+
+    GrepJob(pattern)  # validate pattern via the single owner of the rules
+    buf = np.frombuffer(data, dtype=np.uint8)
+    padded = tok_ops.pad_to(buf, max(128, -(-max(buf.shape[0], 1) // 128) * 128))
+    return _state_result(pattern, _jitted_counter(pattern)(padded))
+
+
+def grep_file(path, pattern: bytes, config: Config = DEFAULT_CONFIG,
+              mesh=None, **kw) -> GrepResult:
+    """Pattern counts over a file via the streaming sharded pipeline."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import executor
+
+    mesh = mesh if mesh is not None else data_mesh()
+    rr = executor.run_job(GrepJob(pattern), path, config=config,
+                          mesh=mesh, **kw)
+    return _state_result(pattern, rr.value)

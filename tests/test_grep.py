@@ -1,0 +1,102 @@
+"""Distributed grep: device counts vs. a pure-Python oracle.
+
+Oracle semantics (module docstring of :mod:`mapreduce_tpu.models.grep`):
+overlapping occurrences; matching lines = lines containing >= 1 occurrence.
+"""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import grep
+
+
+def occurrences(data: bytes, pat: bytes) -> int:
+    return sum(1 for i in range(len(data) - len(pat) + 1)
+               if data[i: i + len(pat)] == pat)
+
+
+def matching_lines(data: bytes, pat: bytes) -> int:
+    return sum(1 for line in data.split(b"\n") if pat in line)
+
+
+def test_overlapping_occurrences():
+    r = grep.grep_bytes(b"aaaa\n", b"aa")
+    assert r.matches == 3  # overlapping, unlike bytes.count's 2
+    assert r.lines == 1
+
+
+@pytest.mark.parametrize("pat", [b"w1", b"w23", b"w1 w", b"zqx"])
+def test_matches_oracle(small_corpus, pat):
+    r = grep.grep_bytes(small_corpus, pat)
+    assert r.matches == occurrences(small_corpus, pat)
+    # Patterns without newline: matching-lines oracle applies exactly.
+    assert r.lines == matching_lines(small_corpus, pat)
+
+
+def test_multiple_matches_one_line_count_once():
+    r = grep.grep_bytes(b"x y x y x\nplain\nx\n", b"x")
+    assert r.matches == 4
+    assert r.lines == 2
+
+
+def test_empty_and_oversized_pattern_rejected():
+    with pytest.raises(ValueError):
+        grep.GrepJob(b"")
+    with pytest.raises(ValueError):
+        grep.GrepJob(b"a" * 257)
+
+
+def test_pattern_longer_than_data():
+    r = grep.grep_bytes(b"hi\n", b"this-pattern-is-longer-than-the-data")
+    assert r.matches == 0 and r.lines == 0
+
+
+def test_streamed_grep_matches_oracle(tmp_path, small_corpus):
+    from mapreduce_tpu.data import reader
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024)
+    r = grep.grep_file(str(path), b"w1", config=cfg)
+    # Separator-free patterns cannot span the separator-aligned chunk seams:
+    # occurrence counts are exact under sharding.
+    assert r.matches == occurrences(small_corpus, b"w1")
+    # Lines may split across rows: exact-to-upper-bound envelope, with the
+    # bound derived from the ACTUAL row count (separator-aligned cuts make
+    # rows shorter than chunk_bytes, so ceil(len/chunk) undercounts rows).
+    n_rows = sum(int((b.lengths > 0).sum())
+                 for b in reader.iter_batches(str(path), 8, cfg.chunk_bytes))
+    exact_lines = matching_lines(small_corpus, b"w1")
+    assert exact_lines <= r.lines <= exact_lines + n_rows - 1
+
+
+def test_64bit_carry_accumulation():
+    """The lo/hi carry math is exact where a uint32 would wrap."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    job = grep.GrepJob(b"x")
+    near = jnp.uint32(0xFFFFFFF0)
+    state = grep.GrepState(near, jnp.uint32(0), near, jnp.uint32(0))
+    update = grep.GrepState(jnp.uint32(0x20), jnp.uint32(0),
+                            jnp.uint32(0x20), jnp.uint32(0))
+    merged = job.combine(state, update)
+    result = grep._state_result(b"x", merged)
+    assert result.matches == 0xFFFFFFF0 + 0x20  # > 2**32
+    assert result.lines == 0xFFFFFFF0 + 0x20
+
+
+def test_grep_cli(tmp_path, capsys):
+    from mapreduce_tpu import cli
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"the cat\nthe dog\nno match\n")
+    assert cli.main([str(path), "--grep", "the"]) == 0
+    out = capsys.readouterr().out
+    assert "Matches:2\nMatching Lines:2\n" in out
+    assert cli.main([str(path), "--grep", "the", "--format", "json"]) == 0
+    assert '"matches": 2' in capsys.readouterr().out
+    assert cli.main([str(path), "--grep", "the", "--stream",
+                     "--format", "tsv"]) == 0
+    assert "matches\t2" in capsys.readouterr().out
