@@ -16,11 +16,19 @@ owns.  Host->device staging is measured and reported separately
 host, local DMA far exceeds the pipeline rate and the headline number is the
 end-to-end bound.
 
+Measurement shape: the whole corpus is staged on device once, then the timed
+window is ONE ``Engine.step_many(..., repeats=R)`` dispatch that cycles the
+resident chunks R times (epoch semantics) — processing corpus*R bytes of
+map+combine work in a single program.  Measured through the tunnel, each
+dispatch costs ~0.6 s in link latency against ~9 ms/chunk of real compute;
+folding the repeat loop inside the compiled scan is what keeps the link out
+of the measurement.
+
 Env knobs: BENCH_MB (corpus size, default 512), BENCH_CHUNK_MB (per-device
-step size, default 32 — the measured sweet spot on v5e), BENCH_SUPERSTEP
-(chunks folded per dispatch via lax.scan, default 8 — fewer, larger
-dispatches dilute per-dispatch link latency), BENCH_BASELINE_MB (CPU
-baseline slice, default 16).
+step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
+(device passes over the resident corpus in the timed dispatch, default 8),
+BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
+BENCH_BASELINE_MB (CPU baseline slice, default 16).
 """
 
 from __future__ import annotations
@@ -38,10 +46,15 @@ def make_zipf_corpus(n_bytes: int, vocab: int = 50_000, a: float = 1.3,
                      seed: int = 7) -> bytes:
     rng = np.random.default_rng(seed)
     words = np.array([b"w%d" % i for i in range(vocab)], dtype=object)
-    # Average word ~6 bytes + separator; oversample then trim.
-    n_words = int(n_bytes / 6.5) + 1024
-    idx = rng.zipf(a, size=n_words).astype(np.int64) % vocab
-    blob = b" ".join(words[idx])
+    # Zipf draws skew short (w1, w2, ...), so bytes-per-word is corpus-
+    # dependent: generate in slabs until the requested size is reached.
+    parts, have = [], 0
+    while have < n_bytes:
+        idx = rng.zipf(a, size=1 << 20).astype(np.int64) % vocab
+        slab = b" ".join(words[idx]) + b" "
+        parts.append(slab)
+        have += len(slab)
+    blob = b"".join(parts)
     return blob[:n_bytes].rsplit(b" ", 1)[0] + b"\n"
 
 
@@ -58,10 +71,16 @@ def cpu_baseline_gbps(data: bytes, repeats: int = 1) -> float:
     return len(data) / 1e9 / best
 
 
+def _log(msg: str, t0: float) -> None:
+    """Phase progress to stderr (stdout stays the single JSON line)."""
+    print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}", file=sys.stderr)
+
+
 def main() -> int:
+    wall0 = time.perf_counter()
     mb = int(os.environ.get("BENCH_MB", "512"))
     chunk_mb = int(os.environ.get("BENCH_CHUNK_MB", "32"))
-    superstep = int(os.environ.get("BENCH_SUPERSTEP", "8"))
+    superstep = int(os.environ.get("BENCH_SUPERSTEP", "0"))  # 0 = all chunks
     base_mb = int(os.environ.get("BENCH_BASELINE_MB", "16"))
 
     # BENCH_INPUT: bench a real corpus file (e.g. enwik8/enwik9 per
@@ -72,6 +91,7 @@ def main() -> int:
             corpus = f.read(mb << 20)
     else:
         corpus = make_zipf_corpus(mb << 20)
+    _log(f"corpus ready: {len(corpus) >> 20} MB", wall0)
 
     import jax
 
@@ -99,46 +119,47 @@ def main() -> int:
     with tempfile.NamedTemporaryFile(dir="/tmp", suffix=".txt", delete=False) as f:
         f.write(corpus)
         path = f.name
+    repeats = int(os.environ.get("BENCH_REPEATS", "8"))
     try:
         batches = list(reader.iter_batches(path, n_dev, cfg.chunk_bytes))
-        # Group K chunks per dispatch; drop any remainder so every dispatch
-        # reuses one compiled superstep program.
-        k = max(1, min(superstep, len(batches) // 2))
-        groups = [batches[i:i + k] for i in range(0, len(batches) - k + 1, k)]
-        if len(groups) < 2:
-            raise SystemExit("BENCH_MB too small: need >= 2 supersteps "
-                             "(warm-up + timed); raise BENCH_MB or lower "
-                             "BENCH_CHUNK_MB/BENCH_SUPERSTEP")
+        # All full-size chunks stay device-resident; the timed dispatch
+        # cycles them `repeats` times (see module docstring).
+        k = max(1, min(superstep or len(batches), len(batches)))
+        group = batches[:k]
         state = engine.init_states()
 
-        # Stage every superstep's chunks on device up front, timing the H2D
-        # transfer by itself (see module docstring; host-side stacking stays
-        # outside the window).  A host fetch is the only reliable sync point
-        # (block_until_ready is not a real barrier under remote-device
-        # tunnels).
-        stacked = [np.stack([b.data for b in g], axis=1) for g in groups]
+        # Stage the group once, timing the H2D transfer by itself (host-side
+        # stacking stays outside the window).  A host fetch is the only
+        # reliable sync point (block_until_ready is not a real barrier under
+        # remote-device tunnels).
+        stacked = np.stack([b.data for b in group], axis=1)
         t0 = time.perf_counter()
-        staged = [jax.device_put(s, engine.sharding) for s in stacked]
+        staged = jax.device_put(stacked, engine.sharding)
         jax.block_until_ready(staged)
-        np.asarray(staged[-1][..., -1:])
-        h2d_gbps = sum(s.nbytes for s in staged) / 1e9 / (time.perf_counter() - t0)
+        np.asarray(staged[..., -1:])
+        h2d_gbps = staged.nbytes / 1e9 / (time.perf_counter() - t0)
+        _log(f"staged {staged.nbytes >> 20} MB on device "
+             f"({h2d_gbps:.3f} GB/s H2D); k={k}, repeats={repeats}", wall0)
 
-        # Warm-up superstep: pays XLA compile; excluded from steady timing.
-        state = engine.step_many(state, staged[0], 0)
+        # Warm-up: pays the XLA compiles (one for the (k, repeats) program,
+        # one for finish -- finish does not donate, so the state stays valid).
+        state = engine.step_many(state, staged, 0, repeats=repeats)
         np.asarray(state.dropped_count)
-        # Warm finish too (it does not donate, so the state stays valid):
-        # its one-time compile otherwise lands inside the timed window.
+        _log("warm-up dispatch done (compile paid)", wall0)
         np.asarray(engine.finish(state).dropped_count)
+        _log("warm finish done", wall0)
+
+        group_bytes = int(sum(b.lengths.sum() for b in group))
         t0 = time.perf_counter()
-        steady_bytes = 0
-        for i, group in enumerate(groups[1:]):
-            state = engine.step_many(state, staged[i + 1], (i + 1) * k)
-            steady_bytes += int(sum(b.lengths.sum() for b in group))
+        state = engine.step_many(state, staged, k * repeats, repeats=repeats)
         table = engine.finish(state)
         np.asarray(table.dropped_count)  # barrier: fetch an existing leaf
         dt = time.perf_counter() - t0
+        steady_bytes = group_bytes * repeats
+        _log(f"timed window done: {dt:.3f}s over {steady_bytes >> 20} MB "
+             f"({repeats} passes)", wall0)
         total_words = int(np.asarray(table.total_count()))
-        processed_bytes = int(sum(b.lengths.sum() for g in groups for b in g))
+        processed_bytes = group_bytes * 2 * repeats  # warm-up + timed
         gbps = steady_bytes / 1e9 / dt
         words_per_s = total_words * (steady_bytes / processed_bytes) / dt
     finally:
@@ -153,7 +174,9 @@ def main() -> int:
         "value": round(gbps, 4),
         "unit": "GB/s",
         "vs_baseline": round(gbps / base, 3) if base else 0.0,
-        "corpus_mb": round(len(corpus) / (1 << 20), 1),  # actual, not requested
+        # The device-resident slice actually measured (BENCH_SUPERSTEP below
+        # the chunk count truncates to the first k chunks).
+        "corpus_mb": round(group_bytes / (1 << 20), 1),
         "devices": n_dev,
         "backend": jax.devices()[0].platform,
         "total_words": total_words,

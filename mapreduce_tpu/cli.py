@@ -130,7 +130,7 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
             if args.stream:
                 result = grep.grep_file(paths, pattern, config=config)
             else:
-                result = grep.grep_bytes(data, pattern, config)
+                result = grep.grep_bytes(data, pattern)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -164,10 +164,18 @@ def main(argv: list[str] | None = None) -> int:
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
-    if args.grep is not None and args.checkpoint:
-        # Honest failure beats a flag silently ignored: grep's scalar state
-        # has no snapshot format yet (the checkpoint layout is table-shaped).
-        parser.error("--checkpoint is not supported with --grep")
+    if args.grep is not None:
+        # Honest failure beats a flag silently ignored: grep mode counts
+        # pattern matches, not words, so word-count-only flags are errors
+        # (and grep's scalar state has no checkpoint snapshot format yet).
+        for flag, present in (("--checkpoint", bool(args.checkpoint)),
+                              ("--ngram", args.ngram != 1),
+                              ("--top-k", bool(args.top_k)),
+                              ("--distinct-sketch", args.distinct_sketch),
+                              ("--count-sketch", args.count_sketch),
+                              ("--estimate", bool(args.estimate))):
+            if present:
+                parser.error(f"{flag} is not supported with --grep")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on

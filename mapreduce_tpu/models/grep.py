@@ -106,6 +106,10 @@ class GrepJob(MapReduceJob):
             raise ValueError(f"grep pattern of {len(pattern)} bytes exceeds "
                              "the 256-byte limit (the match mask unrolls one "
                              "fused comparison per pattern byte)")
+        if 0 in pattern:
+            # NUL is the chunk padding byte: a NUL-bearing pattern would
+            # count phantom matches in padding tails.
+            raise ValueError("grep pattern must not contain NUL bytes")
         self.pattern = np.frombuffer(pattern, dtype=np.uint8)
 
     def init_state(self) -> GrepState:
@@ -147,7 +151,7 @@ def _jitted_counter(pattern: bytes):
     return jax.jit(lambda c: count_matches_in_chunk(c, pat))
 
 
-def grep_bytes(data: bytes, pattern: bytes, config: Config = DEFAULT_CONFIG) -> GrepResult:
+def grep_bytes(data: bytes, pattern: bytes) -> GrepResult:
     """One-call API: pattern counts for an in-memory buffer."""
     from mapreduce_tpu.ops import tokenize as tok_ops
 

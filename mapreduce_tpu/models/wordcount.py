@@ -82,18 +82,17 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
     if config.resolved_backend() == "pallas":
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
-        # Consume the bulk and seam streams separately: building two tables
-        # and merging the (tiny) seam one avoids concatenating a few KB onto
-        # multi-hundred-MB column planes (full-copy per plane).
+        # One aggregation over column + seam emissions together: the seam
+        # rows are ~8.5K entries, absorbed by the big sort for free, where a
+        # separate seam table + merge cost a second (fixed-overhead-bound)
+        # reduce pass per chunk.
         col, seam, overlong = pallas_tok.tokenize_split(
             chunk, max_token_bytes=config.pallas_max_token)
-        bounds = dict(max_token_bytes=config.pallas_max_token,
-                      max_pos=int(chunk.shape[0]))
-        t = table_ops.from_stream(col, capacity, pos_hi=pos_hi, **bounds)
-        seam_cap = min(seam.key_hi.shape[0], capacity)
-        t = table_ops.merge(
-            t, table_ops.from_stream(seam, seam_cap, pos_hi=pos_hi, **bounds),
-            capacity=capacity)
+        stream = pallas_tok.concat_streams(col, seam)
+        t = table_ops.from_stream(
+            stream, capacity, pos_hi=pos_hi,
+            max_token_bytes=config.pallas_max_token,
+            max_pos=int(chunk.shape[0]))
         # ``overlong`` counts occurrences.  For dropped_count (occurrences)
         # that is exact; for dropped_uniques it is the only available upper
         # bound — overlong tokens leave the kernel unhashed, so distinct

@@ -41,6 +41,7 @@ the two tiny fix-up passes the wrapper runs in XLA:
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,25 @@ from mapreduce_tpu.ops.tokenize import TokenStream
 LANES = 128
 DEFAULT_MAX_TOKEN = 32  # W: max token bytes handled fully on the fast path
 DEFAULT_BLOCK_ROWS = 256
+
+
+class PackedTokenStream(NamedTuple):
+    """A TokenStream (first five fields, same order — duck-compatible) plus
+    the kernel's raw ``start << 6 | len`` plane and exact token count.
+
+    Aggregation consumes ``packed`` directly as its sort payload and
+    ``total`` for drop accounting, skipping two stream-sized HBM passes that
+    reconstructing them from pos/length/count would cost.  ``packed`` is
+    None when a nonzero base_offset made the raw plane unusable as-is.
+    """
+
+    key_hi: jax.Array
+    key_lo: jax.Array
+    count: jax.Array
+    pos: jax.Array
+    length: jax.Array
+    packed: jax.Array | None
+    total: jax.Array
 
 
 def _pow_mod32(base: np.uint32, k: int) -> np.uint32:
@@ -81,14 +101,25 @@ def _sep_mask_i32(x: jax.Array) -> jax.Array:
     return sep
 
 
-def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
-                     *, w: int, block_rows: int, data_rows: int):
-    """One grid step: emit (key_hi, key_lo, length) for block positions.
+def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
+                     carry_ref, *, w: int, block_rows: int, data_rows: int):
+    """One grid step: emit pair-compacted (key_hi, key_lo, packed) planes.
 
-    Output row t of block i describes byte-row ``m = i*block_rows + t - 1`` of
-    each lane (one-row offset so the next-byte separator test only ever looks
-    at rows already resident).  Non-emitting positions carry the sentinel key
-    and length 0.
+    Logical output row t of block i describes byte-row ``m = i*block_rows +
+    t - 1`` of each lane (one-row offset so the next-byte separator test only
+    ever looks at rows already resident).  A token end at byte row m requires
+    byte m+1 to be a separator, so two consecutive rows can never both emit —
+    the kernel folds each (2r, 2r+1) row pair to one output row *in VMEM*,
+    writing half-resolution planes: at ~10 GB/s effective HBM bandwidth on
+    the bench chip, the full-resolution planes plus the XLA-side re-read/
+    re-write for pairing and (pos,len) packing were ~700 MB of traffic per
+    32 MB chunk — most of the map phase's cost.
+
+    ``packed`` = ``start_pos << 6 | length`` (the downstream sort payload;
+    requires data length < 2**26 and w <= 63, validated by the wrapper);
+    non-emitting pairs carry the sentinel key and all-ones packed.  ``ntok``
+    accumulates the total emission count so callers get exact totals without
+    another stream-sized pass.
     """
     i = pl.program_id(0)
     tb = block_rows
@@ -100,6 +131,7 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
         # tail, which the seam pass owns).
         carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
         over_ref[0, 0] = jnp.uint32(0)
+        ntok_ref[0, 0] = jnp.uint32(0)
 
     # Widen bytes to int32 immediately: v5e Mosaic has no 8-bit vector
     # compares, and 32-bit lanes are the VPU-native layout anyway.
@@ -154,33 +186,56 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, len_ref, over_ref, carry_ref,
     at_sent = (khi == sent) & (klo == sent)
     klo = jnp.where(at_sent, klo - jnp.uint32(1), klo)
 
-    khi_ref[:] = jnp.where(emit, khi, sent)
-    klo_ref[:] = jnp.where(emit, klo, sent)
-    len_ref[:] = jnp.where(emit, ln, jnp.uint32(0))
+    khi = jnp.where(emit, khi, sent)
+    klo = jnp.where(emit, klo, sent)
+    ln_e = jnp.where(emit, ln, jnp.uint32(0))
+    ntok_ref[0, 0] = ntok_ref[0, 0] + jnp.sum(emit.astype(jnp.int32)).astype(jnp.uint32)
+
+    # packed = start << 6 | length: the sort payload, built where the data
+    # already is.  start = global byte offset of the token's first byte.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 1)
+    start = lane * data_rows + m + 1 - ln_e.astype(jnp.int32)
+    packed = jnp.where(emit, (start.astype(jnp.uint32) << 6) | ln_e,
+                       jnp.uint32(0xFFFFFFFF))
+
+    # Pairwise fold: adjacent rows never both emit, so each (2r, 2r+1) pair
+    # holds at most one token — select it via a sublane-group reshape.
+    def fold(a, take_even):
+        g = a.reshape(tb // 2, 2, LANES)
+        return jnp.where(take_even, g[:, 0, :], g[:, 1, :])
+
+    even_has = ln_e.reshape(tb // 2, 2, LANES)[:, 0, :] > 0
+    khi_ref[:] = fold(khi, even_has)
+    klo_ref[:] = fold(klo, even_has)
+    packed_ref[:] = fold(packed, even_has)
 
 
 def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
                  data_rows: int, interpret: bool):
-    """Run the kernel over the (rows, 128) column view (one trailing pad block)."""
+    """Run the kernel over the (rows, 128) column view (one trailing pad block).
+
+    Returns pair-compacted planes of rows//2 output rows: (key_hi, key_lo,
+    packed), plus the (overlong, token_count) SMEM scalars.
+    """
     rows = cols_padded.shape[0]
     grid = rows // block_rows
     kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
                              data_rows=data_rows)
-    out32 = jax.ShapeDtypeStruct((rows, LANES), jnp.uint32)
-    khi, klo, ln, over = pl.pallas_call(
+    out32 = jax.ShapeDtypeStruct((rows // 2, LANES), jnp.uint32)
+    scalar = jax.ShapeDtypeStruct((1, 1), jnp.uint32)
+    khi, klo, packed, over, ntok = pl.pallas_call(
         kern,
         grid=(grid,),
         in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
-        out_shape=[out32, out32, out32,
-                   jax.ShapeDtypeStruct((1, 1), jnp.uint32)],
-        out_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+        out_shape=[out32, out32, out32, scalar, scalar],
+        out_specs=[pl.BlockSpec((block_rows // 2, LANES), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)] * 3
-        + [pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)],
+        + [pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)] * 2,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
         interpret=interpret,
     )(cols_padded)
-    return khi, klo, ln, over[0, 0]
+    return khi, klo, packed, over[0, 0], ntok[0, 0]
 
 
 def _seam_pass(data: jax.Array, seg_len: int, w: int,
@@ -195,11 +250,17 @@ def _seam_pass(data: jax.Array, seg_len: int, w: int,
     """
     n = data.shape[0]
     wlen = 2 * w + 2
-    pad = jnp.full((w + 1,), constants.PAD_BYTE, dtype=jnp.uint8)
-    padded = jnp.concatenate([pad, data, pad])  # index shift: +w+1
+    # Window j covers [j*L - w - 1, j*L + w + 1): the last w+1 bytes of lane
+    # segment j-1 plus the first w+1 bytes of segment j.  Build all 129
+    # windows from static slices of the (LANES, L) view — a fancy-index
+    # gather here costs ~13 us/element on TPU (measured: ~100 ms for these
+    # ~8.5K bytes, 4x the entire rest of the pipeline).
+    view = data.reshape(LANES, seg_len)
+    pad_row = jnp.full((1, w + 1), constants.PAD_BYTE, dtype=jnp.uint8)
+    tails = jnp.concatenate([pad_row, view[:, seg_len - (w + 1):]], axis=0)
+    heads = jnp.concatenate([view[:, : w + 1], pad_row], axis=0)
+    windows = jnp.concatenate([tails, heads], axis=1)  # (LANES+1, 2w+2)
     starts = jnp.arange(0, n + seg_len, seg_len)  # 129 window origins j*seg_len
-    idx = starts[:, None] + jnp.arange(wlen)[None, :]  # padded[j*L - w - 1 + q]
-    windows = padded[idx]
 
     streams = jax.vmap(tok_ops.tokenize)(windows)  # fields: (129, wlen)
     wpos_end = jnp.arange(wlen)[None, :].astype(jnp.int32)
@@ -269,9 +330,17 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
     n = data.shape[0]
     if n % LANES:
         raise ValueError(f"input length {n} must be a multiple of {LANES}")
+    if n > (1 << 26):
+        raise ValueError(
+            f"input of {n} bytes exceeds the pallas backend's 2**26 (64 MB) "
+            "chunk bound (positions are packed into 26 bits for the sort "
+            "payload); lower chunk_bytes or use the xla backend")
     w = max_token_bytes
     if w < 1:
         raise ValueError(f"max_token_bytes must be >= 1, got {w}")
+    if w > 63:
+        raise ValueError(f"max_token_bytes must be <= 63 (length is packed "
+                         f"into 6 bits), got {w}")
     seg_len = n // LANES
     if block_rows is None:
         # Blocks must cover the W-row lookback plus one row, and stay even
@@ -295,43 +364,56 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
     cols_padded = jnp.concatenate(
         [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
 
-    khi, klo, ln, over_cols = _column_pass(cols_padded, w, block_rows,
-                                           data_rows=seg_len, interpret=interpret)
+    khi, klo, packed, over_cols, n_tokens = _column_pass(
+        cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret)
 
-    # Pairwise compaction: a token end at byte row m requires byte m+1 to be
-    # a separator, so two consecutive byte rows of one lane can never both
-    # end tokens.  Each (2r, 2r+1) output-row pair therefore holds at most
-    # one emission — select it and halve every plane before leaving the
-    # (rows, 128) layout.  Pure elementwise work, and it halves the input to
-    # the downstream sort-based aggregation (the actual hot spot).
-    rows = cols_padded.shape[0]
-    sel = ln[0::2] > 0
-    khi = jnp.where(sel, khi[0::2], khi[1::2])
-    klo = jnp.where(sel, klo[0::2], klo[1::2])
-    ln = jnp.where(sel, ln[0::2], ln[1::2])
-
-    # Reconstruct stream fields.  Output row t of the (rows, 128) planes is
-    # byte row m = t - 1 of each lane; global byte offset = lane*seg_len + m,
-    # token start = end - len + 1.  After halving, t = 2r (+1 if the odd row
-    # was selected).
-    half = rows // 2
-    t_idx = 2 * jax.lax.broadcasted_iota(jnp.int32, (half, LANES), 0) \
-        + jnp.where(sel, 0, 1)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (half, LANES), 1)
-    end = lane * seg_len + (t_idx - 1)
-    has_tok = ln > 0
-    start = jnp.where(
-        has_tok,
-        (end + 1 - ln.astype(jnp.int32)).astype(jnp.uint32)
-        + jnp.asarray(base_offset, jnp.uint32),
-        jnp.uint32(constants.POS_INF))
-    col_stream = TokenStream(
-        key_hi=khi.reshape(-1), key_lo=klo.reshape(-1),
-        count=has_tok.astype(jnp.uint32).reshape(-1),
-        pos=start.reshape(-1), length=ln.reshape(-1))
+    # The kernel already pair-compacted and packed (start << 6 | len) in
+    # VMEM (see _tokenize_kernel); reconstruct the TokenStream view lazily —
+    # pos/length/count are elementwise functions of `packed`, which XLA
+    # fuses into whatever consumes them (aggregation feeds `packed` straight
+    # into its sort, so the reconstructed planes never hit HBM there).
+    khi = khi.reshape(-1)
+    klo = klo.reshape(-1)
+    packed = packed.reshape(-1)
+    has_tok = packed != jnp.uint32(0xFFFFFFFF)
+    ln = jnp.where(has_tok, packed & jnp.uint32(63), jnp.uint32(0))
+    start = jnp.where(has_tok,
+                      (packed >> 6) + jnp.asarray(base_offset, jnp.uint32),
+                      jnp.uint32(constants.POS_INF))
+    base_is_zero = isinstance(base_offset, int) and base_offset == 0
+    col_stream = PackedTokenStream(
+        key_hi=khi, key_lo=klo,
+        count=has_tok.astype(jnp.uint32),
+        pos=start, length=ln,
+        packed=packed if base_is_zero else None,
+        total=n_tokens)
 
     seam_stream, over_seams = _seam_pass(data, seg_len, w, base_offset)
     return col_stream, seam_stream, over_cols + over_seams
+
+
+def concat_streams(col: PackedTokenStream, seam: TokenStream) -> PackedTokenStream:
+    """Append the (tiny) seam stream to the column stream, preserving the
+    packed plane and exact total, so aggregation runs ONCE over both.
+
+    Building a separate seam table and merging it cost ~26 ms/chunk on the
+    bench chip (a second searchsorted while-loop plus six fixed-cost device
+    copies of the 8.5K-row seam arrays); one concatenated sort absorbs the
+    8.5K extra rows for ~free.
+    """
+    sent = jnp.uint32(0xFFFFFFFF)
+    seam_tok = seam.count > 0
+    seam_packed = jnp.where(seam_tok, (seam.pos << 6) | seam.length, sent)
+    cat = lambda a, b: jnp.concatenate([a, b])
+    return PackedTokenStream(
+        key_hi=cat(col.key_hi, seam.key_hi),
+        key_lo=cat(col.key_lo, seam.key_lo),
+        count=cat(col.count, seam.count),
+        pos=cat(col.pos, seam.pos),
+        length=cat(col.length, seam.length),
+        packed=cat(col.packed, seam_packed) if col.packed is not None else None,
+        total=col.total + jnp.sum(seam.count),
+    )
 
 
 def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
@@ -341,5 +423,4 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0,
     """Single-stream view of :func:`tokenize_split`: ``(stream, overlong)``."""
     col, seam, overlong = tokenize_split(data, base_offset, max_token_bytes,
                                          block_rows, interpret)
-    cat = lambda a, b: jnp.concatenate([a, b])
-    return TokenStream(*(cat(a, b) for a, b in zip(col, seam))), overlong
+    return concat_streams(col, seam), overlong

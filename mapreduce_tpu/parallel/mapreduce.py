@@ -99,7 +99,7 @@ class Engine:
         self._sharded = mesh_mod.sharded(mesh, axes if len(axes) > 1 else axes[0])
         self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
-        self._step_many_fns: dict[int, Any] = {}
+        self._step_many_fns: dict[tuple[int, int], Any] = {}  # (K, repeats)
         self._finish_fn = None
 
     def _device_index(self):
@@ -145,7 +145,7 @@ class Engine:
         )
         return jax.jit(fn, donate_argnums=(0,))
 
-    def _build_step_many(self, k: int):
+    def _build_step_many(self, k: int, repeats: int = 1):
         axis, job, n = self.axis, self.job, self.n_devices
 
         def local_many(state, chunks, step0):
@@ -153,13 +153,16 @@ class Engine:
             my = chunks[0]  # (k, chunk_bytes) after shard_map
             dev = self._device_index()
 
-            def body(st, xs):
-                chunk, j = xs
+            def body(st, j):
+                # Cycle over the k resident chunks: pass r of `repeats`
+                # re-reads them with fresh step indices (epoch semantics).
+                chunk = jax.lax.dynamic_index_in_dim(
+                    my, (j % jnp.uint32(k)).astype(jnp.int32), keepdims=False)
                 chunk_id = (step0 + j) * jnp.uint32(n) + dev
                 return job.combine(st, job.map_chunk(chunk, chunk_id)), None
 
             new, _ = jax.lax.scan(
-                body, local, (my, jnp.arange(k, dtype=jnp.uint32)))
+                body, local, jnp.arange(k * repeats, dtype=jnp.uint32))
             return jax.tree.map(lambda x: x[None], new)
 
         fn = shard_map(
@@ -194,20 +197,29 @@ class Engine:
         chunks = jax.device_put(chunks, self._sharded)
         return self._step_fn(state, chunks, jnp.uint32(step_index))
 
-    def step_many(self, state: Any, chunks: jax.Array, step_index: int) -> Any:
+    def step_many(self, state: Any, chunks: jax.Array, step_index: int,
+                  repeats: int = 1) -> Any:
         """K map+combine steps in ONE dispatch via ``lax.scan``.
 
         ``chunks``: uint8[n_devices, K, chunk_bytes].  Equivalent to K calls
         of :meth:`step` with step indices ``step_index .. step_index+K-1``
         (chunk_ids match exactly), but amortizes per-dispatch overhead —
         which dominates under high-latency device links — over K steps.
-        Compiles once per distinct K.
+        Compiles once per distinct (K, repeats).
+
+        ``repeats > 1`` folds the K device-resident chunks ``repeats`` times
+        (epoch semantics: pass r re-reads every chunk with fresh step
+        indices ``step_index + r*K ..``), processing K*repeats chunks in one
+        dispatch without re-staging — the multi-pass analogue of a training
+        loop's epochs, and the lever that keeps per-dispatch overhead out of
+        throughput measurements on high-latency links.
         """
         k = chunks.shape[1]
-        if k not in self._step_many_fns:
-            self._step_many_fns[k] = self._build_step_many(k)
+        key = (k, repeats)
+        if key not in self._step_many_fns:
+            self._step_many_fns[key] = self._build_step_many(k, repeats)
         chunks = jax.device_put(chunks, self._sharded)
-        return self._step_many_fns[k](state, chunks, jnp.uint32(step_index))
+        return self._step_many_fns[key](state, chunks, jnp.uint32(step_index))
 
     def finish(self, state: Any) -> Any:
         """Collective global merge + finalize.  Result is replicated."""
