@@ -124,6 +124,10 @@ def main() -> int:
         batches = list(reader.iter_batches(path, n_dev, cfg.chunk_bytes))
         # All full-size chunks stay device-resident; the timed dispatch
         # cycles them `repeats` times (see module docstring).
+        if not batches:
+            raise SystemExit("no full chunks: corpus smaller than one "
+                             f"{chunk_mb} MB chunk; raise BENCH_MB or check "
+                             "BENCH_INPUT, or lower BENCH_CHUNK_MB")
         k = max(1, min(superstep or len(batches), len(batches)))
         group = batches[:k]
         state = engine.init_states()

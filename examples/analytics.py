@@ -1,0 +1,51 @@
+"""The newer model families in one pass: n-grams, frequency sketch, grep.
+
+    python examples/analytics.py [path]
+
+- Bigram counts: `--ngram 2` semantics (order-sensitive token pairs, reported
+  as their exact first-occurrence source spans).
+- Count-Min frequency estimates: query ANY word or phrase after the run,
+  including ones the exact table spilled past capacity.
+- Distributed grep: overlapping occurrences + matching lines of a pattern.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import grep
+from mapreduce_tpu.runtime import executor
+
+if len(sys.argv) > 1:
+    path = sys.argv[1]
+else:  # demo corpus
+    f = tempfile.NamedTemporaryFile(suffix=".txt", delete=False)
+    f.write(b"the quick brown fox jumps over the lazy dog\n" * 200
+            + b"the quick red fox naps\n" * 50)
+    f.close()
+    path = f.name
+
+cfg = Config(chunk_bytes=1 << 20, table_capacity=1 << 14)
+
+# Bigrams, top 5 by frequency.
+bi = executor.count_file(path, config=cfg, ngram=2, top_k=5)
+print("top bigrams:")
+for span, count in bi.as_dict().items():
+    print(f"  {span.decode()!r}\t{count}")
+
+# Frequency sketch: estimates survive table overflow.  The sketch keys
+# match the run's gram order: query words on a unigram run, spans on an
+# n-gram run.
+r = executor.count_file(path, config=cfg, count_sketch=True)
+for q in (b"the", b"fox", b"not-in-corpus"):
+    print(f"estimate {q.decode()!r}: {r.estimate_count(q)}")
+r2 = executor.count_file(path, config=cfg, ngram=2, count_sketch=True)
+print(f"estimate 'quick brown' (bigram run): {r2.estimate_count(b'quick brown')}")
+
+# Grep.
+g = grep.grep_file(path, b"quick", config=cfg)
+print(f"grep 'quick': {g.matches} matches on {g.lines} lines")

@@ -79,6 +79,30 @@ def empty(capacity: int) -> CountTable:
     return CountTable(sent, jnp.array(sent), zero, inf, jnp.array(inf), jnp.array(zero), s0, jnp.uint32(0))
 
 
+def _segment_heads(seg: jax.Array, capacity: int) -> jax.Array:
+    """First sorted-row index of each of the first capacity+1 segments.
+
+    Equivalent to ``jnp.searchsorted(seg, arange(capacity+1))`` but as an
+    UNROLLED binary search: ``jnp.searchsorted``'s while-loop lowering pays
+    a fixed per-iteration cost plus loop-carry device copies on TPU
+    (~15 ms/chunk measured); the static log-n chain of gathers is both
+    cheaper and fusion-friendly.
+    """
+    n = seg.shape[0]
+    q = jnp.arange(capacity + 1, dtype=jnp.int32)
+    lo = jnp.zeros((capacity + 1,), jnp.int32)
+    hi = jnp.full((capacity + 1,), n, jnp.int32)
+    # Range [0, n] holds n+1 candidate answers: n.bit_length() iterations
+    # always suffice ((n-1).bit_length() is one short when n is a power of
+    # two — exactly the table capacities).
+    for _ in range(max(1, n.bit_length())):
+        mid = (lo + hi) >> 1
+        right = seg[jnp.minimum(mid, n - 1)] < q
+        lo = jnp.where(right, mid + 1, lo)
+        hi = jnp.where(right, hi, mid)
+    return hi
+
+
 def _segment_boundaries(key_hi, key_lo):
     """Boundary mask + segment ranks of key-sorted rows (shared by the
     generic and packed reduce paths so their grouping can never diverge)."""
@@ -118,7 +142,7 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity:
     inf = jnp.uint32(constants.POS_INF)
 
     # Segment j occupies sorted rows [head[j], head[j+1]).
-    head = jnp.searchsorted(seg, jnp.arange(capacity + 1, dtype=jnp.int32))
+    head = _segment_heads(seg, capacity)
     fi = jnp.minimum(head[:capacity], n - 1)
 
     csum = jnp.cumsum(count)  # uint32 inclusive prefix sums
@@ -198,7 +222,7 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     _, rank = _segment_boundaries(key_hi, key_lo)
 
     # Segment j occupies rows [head[j], head[j+1]) in sorted order.
-    head = jnp.searchsorted(rank, jnp.arange(capacity + 1, dtype=jnp.int32))
+    head = _segment_heads(rank, capacity)
     fi = jnp.minimum(head[:capacity], n - 1)
     count_u = (head[1:] - head[:capacity]).astype(jnp.uint32)
 
