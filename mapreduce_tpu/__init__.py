@@ -14,4 +14,22 @@ single-thread serial reduce (``main.cu:119-123``), ``cudaMemcpy`` transport
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG, SMALL_CONFIG
 from mapreduce_tpu.version import __version__
 
-__all__ = ["Config", "DEFAULT_CONFIG", "SMALL_CONFIG", "__version__"]
+
+def count_words(data: bytes, config: Config = DEFAULT_CONFIG):
+    """Top-level convenience: exact word counts for an in-memory buffer.
+    See :func:`mapreduce_tpu.models.wordcount.count_words`."""
+    from mapreduce_tpu.models import wordcount
+
+    return wordcount.count_words(data, config)
+
+
+def count_file(path, **kw):
+    """Top-level convenience: streaming sharded word count over file(s).
+    See :func:`mapreduce_tpu.runtime.executor.count_file`."""
+    from mapreduce_tpu.runtime import executor
+
+    return executor.count_file(path, **kw)
+
+
+__all__ = ["Config", "DEFAULT_CONFIG", "SMALL_CONFIG", "__version__",
+           "count_words", "count_file"]
