@@ -117,6 +117,12 @@ def _echo_file(paths: list[str]) -> None:
     sys.stdout.buffer.flush()
 
 
+def _print_stats(input_bytes: int, count: int, unit: str, elapsed: float) -> None:
+    print(f"[stats] {input_bytes} bytes, {count} {unit}, "
+          f"{elapsed:.3f}s, {input_bytes / 1e9 / elapsed:.3f} GB/s",
+          file=sys.stderr)
+
+
 def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     """--grep mode: pattern counts instead of word counts."""
     from mapreduce_tpu.models import grep
@@ -146,9 +152,7 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
         out.write(f"Matches:{result.matches}\n")
         out.write(f"Matching Lines:{result.lines}\n")
     if args.stats:
-        gb = input_bytes / 1e9
-        print(f"[stats] {input_bytes} bytes, {result.matches} matches, "
-              f"{elapsed:.3f}s, {gb / elapsed:.3f} GB/s", file=sys.stderr)
+        _print_stats(input_bytes, result.matches, "matches", elapsed)
     return 0
 
 
@@ -272,9 +276,7 @@ def main(argv: list[str] | None = None) -> int:
         out.write(json.dumps(payload) + "\n")
 
     if args.stats:
-        gb = input_bytes / 1e9
-        print(f"[stats] {input_bytes} bytes, {result.total} words, "
-              f"{elapsed:.3f}s, {gb / elapsed:.3f} GB/s", file=sys.stderr)
+        _print_stats(input_bytes, result.total, "words", elapsed)
     return 0
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mapreduce_tpu import constants
+from mapreduce_tpu.ops.tokenize import _fmix32  # single avalanche owner
 
 DEFAULT_PRECISION = 14  # 2**14 registers = 64 KiB of uint32; ~0.8% error
 
@@ -114,19 +115,10 @@ def cms_empty(depth: int = CMS_DEPTH, width_log2: int = CMS_WIDTH_LOG2) -> jax.A
     return jnp.zeros((depth, 1 << width_log2), dtype=jnp.uint32)
 
 
-def _fmix32_jnp(x: jax.Array) -> jax.Array:
-    x = x ^ (x >> 16)
-    x = x * constants.FMIX_C1
-    x = x ^ (x >> 13)
-    x = x * constants.FMIX_C2
-    x = x ^ (x >> 16)
-    return x
-
-
 def _cms_bucket_jnp(key_hi: jax.Array, key_lo: jax.Array, row: int,
                     width_mask: int) -> jax.Array:
-    h = _fmix32_jnp((key_hi ^ jnp.uint32(_CMS_SALTS[row])) * constants.FMIX_C1
-                    + key_lo * constants.FMIX_C2 + jnp.uint32(row))
+    h = _fmix32((key_hi ^ jnp.uint32(_CMS_SALTS[row])) * constants.FMIX_C1
+                + key_lo * constants.FMIX_C2 + jnp.uint32(row))
     return (h & jnp.uint32(width_mask)).astype(jnp.int32)
 
 
