@@ -61,20 +61,25 @@ class Config:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
-        if self.backend != "xla" and not 1 <= self.pallas_max_token <= 512:
+        if self.backend != "xla" and not 1 <= self.pallas_max_token <= 63:
             # 'auto' may resolve to pallas at runtime; fail at construction,
-            # not mid-trace inside the kernel.  The upper bound keeps the
-            # kernel's unrolled W-step lookback loop compilable; tokens
-            # longer than W are accounted, and the xla backend handles any
-            # length exactly.
+            # not mid-trace inside the kernel.  The kernel packs token length
+            # into 6 bits of its sort payload, so W <= 63; tokens longer than
+            # W are accounted, and the xla backend handles any length exactly.
             raise ValueError(
-                f"pallas_max_token must be in [1, 512], got {self.pallas_max_token}")
+                f"pallas_max_token must be in [1, 63], got {self.pallas_max_token}")
         if self.backend == "pallas" and self.chunk_bytes < self.pallas_min_chunk:
             # Seam windows must not overlap: lane segment >= 2W+2 bytes.
             # ('auto' instead falls back to xla for chunks this small.)
             raise ValueError(
                 f"pallas backend needs chunk_bytes >= {self.pallas_min_chunk} "
                 f"for pallas_max_token={self.pallas_max_token}")
+        if self.backend == "pallas" and self.chunk_bytes > (1 << 26):
+            # Positions pack into 26 bits of the kernel's sort payload.
+            # ('auto' instead falls back to xla above this size.)
+            raise ValueError(
+                f"pallas backend needs chunk_bytes <= {1 << 26} (64 MB), "
+                f"got {self.chunk_bytes}")
 
     @property
     def pallas_min_chunk(self) -> int:
@@ -92,7 +97,8 @@ class Config:
             return self.backend
         import jax
 
-        if jax.default_backend() == "tpu" and self.chunk_bytes >= self.pallas_min_chunk:
+        if (jax.default_backend() == "tpu"
+                and self.pallas_min_chunk <= self.chunk_bytes <= (1 << 26)):
             return "pallas"
         return "xla"
 

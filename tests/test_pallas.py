@@ -128,3 +128,40 @@ def _interpret_mode():
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.force_tpu_interpret_mode()
+
+
+def test_packed_bounds_validation():
+    """The packed sort payload pins the kernel's envelope: 26-bit positions
+    (64 MB chunks) and 6-bit lengths (W <= 63); out-of-envelope requests
+    fail loudly instead of wrapping."""
+    import jax.numpy as jnp
+    import pytest
+    from mapreduce_tpu.ops.pallas import tokenize as pt
+
+    data = jnp.zeros((1 << 12,), jnp.uint8)
+    with pytest.raises(ValueError, match="64 MB"):
+        pt.tokenize_split(jnp.zeros(((1 << 26) + 128,), jnp.uint8))
+    with pytest.raises(ValueError, match="<= 63"):
+        pt.tokenize_split(data, max_token_bytes=64)
+
+
+def test_packed_stream_consistency(small_corpus):
+    """PackedTokenStream's packed plane and total agree with its own
+    reconstructed pos/length/count fields."""
+    import numpy as np
+    from mapreduce_tpu import constants
+    from mapreduce_tpu.ops import tokenize as tok_ops
+    from mapreduce_tpu.ops.pallas import tokenize as pt
+
+    # Lane segments must cover the 2W+2 seam window: >= 66*128 bytes.
+    padded_len = max(-(-len(small_corpus) // 128) * 128, 128 * 128)
+    buf = tok_ops.pad_to(np.frombuffer(small_corpus, np.uint8), padded_len)
+    col, seam, over = pt.tokenize_split(buf)
+    packed = np.asarray(col.packed)
+    count = np.asarray(col.count)
+    has = packed != 0xFFFFFFFF
+    assert np.array_equal(has.astype(np.uint32), count)
+    assert int(col.total) == int(count.sum())
+    np.testing.assert_array_equal(np.asarray(col.pos)[has], (packed >> 6)[has])
+    np.testing.assert_array_equal(np.asarray(col.length)[has],
+                                  (packed & 63)[has])
