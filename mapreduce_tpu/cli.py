@@ -134,7 +134,10 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     try:
         with profiling.trace(args.profile):
             if args.stream:
-                result = grep.grep_file(paths, pattern, config=config)
+                result = grep.grep_file(
+                    paths, pattern, config=config,
+                    checkpoint_path=args.checkpoint,
+                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
             else:
                 result = grep.grep_bytes(data, pattern)
     except ValueError as e:
@@ -165,15 +168,15 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"--ngram must be >= 1, got {args.ngram}")
     if (args.count_sketch or args.estimate) and not args.stream:
         parser.error("--count-sketch/--estimate require --stream")
+    if args.checkpoint and not args.stream:
+        parser.error("--checkpoint requires --stream")
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
     if args.grep is not None:
         # Honest failure beats a flag silently ignored: grep mode counts
-        # pattern matches, not words, so word-count-only flags are errors
-        # (and grep's scalar state has no checkpoint snapshot format yet).
-        for flag, present in (("--checkpoint", bool(args.checkpoint)),
-                              ("--ngram", args.ngram != 1),
+        # pattern matches, not words, so word-count-only flags are errors.
+        for flag, present in (("--ngram", args.ngram != 1),
                               ("--top-k", bool(args.top_k)),
                               ("--distinct-sketch", args.distinct_sketch),
                               ("--count-sketch", args.count_sketch),

@@ -129,6 +129,13 @@ class GrepJob(MapReduceJob):
     def merge(self, a: GrepState, b: GrepState) -> GrepState:
         return self.combine(a, b)
 
+    def identity(self) -> str:
+        # The pattern IS the job: a different pattern's snapshot has the
+        # same state shape but means different counts.
+        import hashlib
+
+        return "grep:" + hashlib.sha256(self.pattern.tobytes()).hexdigest()[:16]
+
 
 class GrepResult(NamedTuple):
     """Host-side result."""

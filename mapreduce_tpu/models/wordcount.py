@@ -190,6 +190,9 @@ class WordCountJob:
     def finalize(self, state):
         return state
 
+    def identity(self) -> str:
+        return "wordcount"
+
 
 class TopKWordCountJob(WordCountJob):
     """WordCount whose device-side finalize keeps only the k most frequent
@@ -201,6 +204,11 @@ class TopKWordCountJob(WordCountJob):
 
     def finalize(self, state):
         return table_ops.top_k(state, self.k)
+
+    def identity(self) -> str:
+        # k only affects finalize, but including it keeps resume semantics
+        # obvious: one checkpoint, one job description.
+        return f"wordcount-top{self.k}"
 
 
 class NGramCountJob(WordCountJob):
@@ -238,6 +246,11 @@ class NGramCountJob(WordCountJob):
 
     def finalize(self, state):
         return table_ops.top_k(state, self.k) if self.k else state
+
+    def identity(self) -> str:
+        # Resuming a bigram run's snapshot as a trigram run (same shapes!)
+        # would mix gram orders: n is part of the job identity.
+        return f"ngram{self.n}" + (f"-top{self.k}" if self.k else "")
 
 
 class SketchedState(NamedTuple):
@@ -300,6 +313,9 @@ class _SketchComposedJob:
 
     def finalize(self, state):
         return self.state_cls(self.base.finalize(state[0]), state[1])
+
+    def identity(self) -> str:
+        return f"{type(self).__name__.lower()}({self.base.identity()})"
 
 
 class FreqSketchedWordCountJob(_SketchComposedJob):

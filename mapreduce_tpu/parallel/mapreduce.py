@@ -60,6 +60,15 @@ class MapReduceJob:
     def finalize(self, state: Any) -> Any:
         return state
 
+    def identity(self) -> str:
+        """Stable description of what this job computes, for checkpoint
+        fingerprints: resuming a snapshot under a job with a different
+        identity is refused (e.g. a grep for a different pattern, whose
+        state SHAPE is identical but whose accumulated numbers mean
+        something else).  Subclasses with parameters that change the
+        meaning of accumulated state must include them."""
+        return type(self).__name__.lower()
+
 
 class Engine:
     """Compiles and runs a :class:`MapReduceJob` over a mesh.

@@ -2,12 +2,16 @@
 
 The reference is single-shot batch with no persistence (SURVEY §5: all state
 freed at exit, ``main.cu:219-220``).  For 100 GB-scale corpora the executor
-periodically saves the per-device count state plus the ingest cursor, so a
+periodically saves the per-device job state plus the ingest cursor, so a
 failed run resumes from the last shard boundary instead of restarting.
 
-Format: a single ``.npz`` (atomic rename on write) holding the stacked
-CountTable leaves, the ingest cursor (file offset + step index), and the
-per-step row base offsets needed for string recovery.
+Format: a single ``.npz`` (atomic rename on write) holding the job state's
+flattened pytree leaves (ANY MapReduceJob state — count tables, sketched
+states, grep scalars — not just tables), the ingest cursor (file offset +
+step index), and the per-step row base offsets needed for string recovery.
+Loading validates the leaves against a template of the running job's state,
+so structural drift (different job kind, changed table capacity, sketched vs
+plain) surfaces as :class:`CheckpointMismatch` instead of silent corruption.
 """
 
 from __future__ import annotations
@@ -16,12 +20,10 @@ import hashlib
 import json
 import os
 import tempfile
+from typing import Any
 
+import jax
 import numpy as np
-
-from mapreduce_tpu.ops.table import CountTable
-
-_FIELDS = list(CountTable._fields)
 
 
 class CheckpointMismatch(RuntimeError):
@@ -30,7 +32,8 @@ class CheckpointMismatch(RuntimeError):
 
 def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
                     backend: str = "xla", pallas_max_token: int = 0,
-                    byte_range: tuple[int, int] | None = None) -> dict:
+                    byte_range: tuple[int, int] | None = None,
+                    job_identity: str = "") -> dict:
     """Identity of a run: resuming under a different identity is an error.
 
     The input file is fingerprinted by size + a head/tail content hash, so a
@@ -38,8 +41,8 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
     backend (and its token-length envelope) is part of the identity because
     it changes counting semantics: the pallas backend drops >W tokens into
     ``dropped_*``, so resuming under the other backend would mix semantics
-    mid-run.  Table capacity is deliberately not in the dict: it is validated
-    against the saved arrays' actual shape (ground truth) by the executor.
+    mid-run.  Capacities are deliberately not in the dict: they are validated
+    against the saved leaves' actual shapes (ground truth) at load.
     """
     paths = [input_path] if isinstance(input_path, (str, bytes, os.PathLike)) \
         else list(input_path)
@@ -60,7 +63,11 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
             "n_devices": n_devices, "chunk_bytes": chunk_bytes,
             "backend": backend,
             "pallas_max_token": pallas_max_token if backend == "pallas" else 0,
-            "byte_range": list(byte_range) if byte_range else None}
+            "byte_range": list(byte_range) if byte_range else None,
+            # What the accumulated numbers MEAN: two jobs can share a state
+            # shape (any two grep patterns) yet be unresumable across each
+            # other (MapReduceJob.identity).
+            "job": job_identity}
 
 
 # Values assumed for fingerprint keys absent from an older checkpoint's meta
@@ -69,23 +76,20 @@ _FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0,
                          "byte_range": None}
 
 
-def save(path: str, state: CountTable, step: int, offset: int,
-         bases: np.ndarray, fingerprint: dict | None = None,
-         extras: dict[str, np.ndarray] | None = None) -> None:
+def save(path: str, state: Any, step: int, offset: int,
+         bases: np.ndarray, fingerprint: dict | None = None) -> None:
     """Atomically persist a run snapshot.
 
     Args:
-      state: stacked per-device CountTable (leaves shaped [D, ...]).
+      state: the job's stacked per-device state — any pytree of arrays
+        (leaves shaped [D, ...]).
       step: next step index to execute.
       offset: file offset ingest should resume from.
       bases: int64[steps_done, D] absolute row base offsets so far.
       fingerprint: run identity from :func:`run_fingerprint`.
-      extras: additional named arrays riding the snapshot (e.g. HLL sketch
-        registers).  Round-tripped verbatim by :func:`load`.
     """
-    payload = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
-    for k, v in (extras or {}).items():
-        payload[f"__extra_{k}"] = np.asarray(v)
+    leaves = jax.tree.leaves(state)
+    payload = {f"__leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     payload["__step"] = np.int64(step)
     payload["__offset"] = np.int64(offset)
     payload["__bases"] = np.asarray(bases, dtype=np.int64)
@@ -104,16 +108,26 @@ def save(path: str, state: CountTable, step: int, offset: int,
         raise
 
 
-def load(path: str, expect_fingerprint: dict | None = None
-         ) -> tuple[CountTable, int, int, np.ndarray, dict[str, np.ndarray]]:
-    """Load a snapshot; returns (state, step, offset, bases, extras).
+def load(path: str, template: Any = None,
+         expect_fingerprint: dict | None = None
+         ) -> tuple[Any, int, int, np.ndarray]:
+    """Load a snapshot; returns (state, step, offset, bases).
 
-    ``extras`` round-trips whatever :func:`save` was given (empty dict for
-    snapshots written without extras).  If ``expect_fingerprint`` is given,
-    raises :class:`CheckpointMismatch` when the snapshot came from a
-    different input file, device count, or chunk size — silently resuming
-    across those would corrupt counts.
+    ``template`` is a pytree with the running job's state structure (e.g.
+    ``Engine.init_states()`` output); the snapshot's leaves are validated
+    against its leaves' shapes and dtypes and unflattened into the same
+    structure.  Raises :class:`CheckpointMismatch` when the snapshot has a
+    different state structure — a different job kind, a sketched run
+    resuming a plain run's snapshot (or vice versa), a changed table
+    capacity or device count — or, with ``expect_fingerprint``, a different
+    input file / chunk geometry.  Silently resuming across any of those
+    would corrupt counts.
+
+    ``template=None`` skips validation and returns the state as the flat
+    list of saved leaves (inspection/debugging only).
     """
+    t_leaves, treedef = (None, None) if template is None \
+        else jax.tree.flatten(template)
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta"]).decode() or "{}") if "__meta" in z else {}
         if expect_fingerprint:
@@ -127,10 +141,30 @@ def load(path: str, expect_fingerprint: dict | None = None
                         f"checkpoint {path} was written with {key}={got!r}, "
                         f"this run has {key}={want!r}; delete the checkpoint "
                         f"or rerun with the original configuration")
-        state = CountTable(**{f: z[f] for f in _FIELDS})
-        extras = {k[len("__extra_"):]: z[k] for k in z.files
-                  if k.startswith("__extra_")}
-        return state, int(z["__step"]), int(z["__offset"]), z["__bases"], extras
+        n_saved = sum(1 for k in z.files if k.startswith("__leaf_"))
+        if template is None:
+            leaves = [z[f"__leaf_{i}"] for i in range(n_saved)]
+            return leaves, int(z["__step"]), int(z["__offset"]), z["__bases"]
+        if n_saved != len(t_leaves):
+            raise CheckpointMismatch(
+                f"checkpoint {path} holds a different state structure "
+                f"({n_saved} leaves vs this job's {len(t_leaves)} — e.g. a "
+                f"sketched run resuming a plain run's snapshot, or a "
+                f"different job kind); delete the checkpoint or rerun with "
+                f"the original configuration")
+        leaves = []
+        for i, want in enumerate(t_leaves):
+            got = z[f"__leaf_{i}"]
+            if tuple(got.shape) != tuple(want.shape) or got.dtype != np.dtype(want.dtype):
+                raise CheckpointMismatch(
+                    f"checkpoint {path} leaf {i} is {got.dtype}{got.shape}, "
+                    f"this run expects {np.dtype(want.dtype)}{tuple(want.shape)} "
+                    f"(changed capacity, device count, or sketch precision); "
+                    f"delete the checkpoint or rerun with the original "
+                    f"configuration")
+            leaves.append(got)
+        state = jax.tree.unflatten(treedef, leaves)
+        return state, int(z["__step"]), int(z["__offset"]), z["__bases"]
 
 
 def exists(path: str) -> bool:

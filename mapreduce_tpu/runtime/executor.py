@@ -47,46 +47,6 @@ class RunResult:
     bases: np.ndarray  # int64[steps, D] row base offsets (string recovery)
 
 
-def _split_state(state_host) -> tuple[Optional[table_ops.CountTable], Optional[dict]]:
-    """(table, extras) decomposition of a host state for checkpointing.
-    Returns (None, None) for state types the snapshot format cannot hold."""
-    if isinstance(state_host, table_ops.CountTable):
-        return state_host, None
-    if isinstance(state_host, SketchedState):
-        return state_host.table, {"hll_registers": np.asarray(state_host.registers)}
-    if isinstance(state_host, FreqSketchedState):
-        return state_host.table, {"cms": np.asarray(state_host.cms)}
-    return None, None
-
-
-_SKETCH_KINDS = (("hll_registers", SketchedWordCountJob, "--distinct-sketch"),
-                 ("cms", FreqSketchedWordCountJob, "--count-sketch"))
-
-
-def _rebuild_state(job, table: table_ops.CountTable, extras: dict,
-                   checkpoint_path: str):
-    """Inverse of :func:`_split_state` for the running job's state type.
-
-    Raises :class:`checkpoint.CheckpointMismatch` when the snapshot and the
-    job disagree about the state structure (e.g. a --distinct-sketch run
-    resuming a plain run's checkpoint, or vice versa): resuming would either
-    crash mid-trace or silently drop the sketch."""
-    job_kind = next((k for k, cls, _ in _SKETCH_KINDS if isinstance(job, cls)), None)
-    ckpt_kind = next((k for k, _, _ in _SKETCH_KINDS if k in extras), None)
-    if job_kind != ckpt_kind:
-        def name(kind):
-            return next((flag for k, _, flag in _SKETCH_KINDS if k == kind), "no sketch")
-        raise ckpt_mod.CheckpointMismatch(
-            f"checkpoint {checkpoint_path} was written with {name(ckpt_kind)} "
-            f"state but this run uses {name(job_kind)}; delete the checkpoint "
-            f"or rerun with the original configuration")
-    if job_kind is None:
-        return table
-    if job_kind == "hll_registers":
-        return SketchedState(table, extras["hll_registers"])
-    return FreqSketchedState(table, extras["cms"])
-
-
 def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
@@ -123,20 +83,17 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     bases_list: list[np.ndarray] = []
     fingerprint = ckpt_mod.run_fingerprint(
         path, n_dev, config.chunk_bytes, backend=config.resolved_backend(),
-        pallas_max_token=config.pallas_max_token, byte_range=byte_range) \
+        pallas_max_token=config.pallas_max_token, byte_range=byte_range,
+        job_identity=job.identity()) \
         if checkpoint_path else None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
-        state_np, start_step, start_offset, bases_arr, extras = ckpt_mod.load(
-            checkpoint_path, expect_fingerprint=fingerprint)
-        saved_cap = state_np.key_hi.shape[-1]
-        if saved_cap != config.table_capacity:
-            # Shapes are ground truth: merging a restored wide table into a
-            # narrower accumulator would silently spill entries mid-run.
-            raise ckpt_mod.CheckpointMismatch(
-                f"checkpoint {checkpoint_path} has table_capacity={saved_cap}, "
-                f"this run has {config.table_capacity}; delete the checkpoint "
-                f"or rerun with the original configuration")
-        state_np = _rebuild_state(job, state_np, extras, checkpoint_path)
+        # An abstract state (shapes/dtypes only, no device allocation) is
+        # the structural template: any drift in job kind, capacities,
+        # sketch precision, or device count surfaces as CheckpointMismatch
+        # (shapes are ground truth).
+        template = jax.eval_shape(engine.init_states)
+        state_np, start_step, start_offset, bases_arr = ckpt_mod.load(
+            checkpoint_path, template=template, expect_fingerprint=fingerprint)
         state = jax.device_put(state_np, engine._sharded)
         bases_list = list(bases_arr)
         log_event(logger, "resumed from checkpoint", step=start_step, offset=start_offset)
@@ -175,16 +132,14 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         if (checkpoint_every and checkpoint_path
                 and step_index // checkpoint_every > last_ckpt):
             last_ckpt = step_index // checkpoint_every
-            # Synchronize, then snapshot the state and ingest cursor.
+            # Synchronize, then snapshot the state and ingest cursor.  The
+            # snapshot format holds ANY job state pytree (tables, sketched
+            # states, grep scalars alike).
             state_host = jax.tree.map(np.asarray, state)
-            table, extras = _split_state(state_host)
-            if table is not None:
-                ckpt_mod.save(checkpoint_path, table, step_index,
-                              bytes_done, np.stack(bases_list),
-                              fingerprint=fingerprint, extras=extras)
-                log_event(logger, "checkpoint", step=step_index, path=checkpoint_path)
-            else:
-                log_event(logger, "checkpoint skipped: unsupported state type")
+            ckpt_mod.save(checkpoint_path, state_host, step_index,
+                          bytes_done, np.stack(bases_list),
+                          fingerprint=fingerprint)
+            log_event(logger, "checkpoint", step=step_index, path=checkpoint_path)
         return state
 
     timer.start("stream")
@@ -259,7 +214,7 @@ def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
     ``distinct_sketch`` composes a HyperLogLog over the run, populating
     ``result.distinct_estimate`` — accurate (~0.8%) even when distinct words
     spill past table capacity.  Sketched runs checkpoint like plain ones
-    (the registers ride snapshots as extras); resuming a checkpoint across
+    (snapshots hold the whole state pytree); resuming a checkpoint across
     sketched/unsketched configurations raises CheckpointMismatch.
 
     ``count_sketch`` composes a Count-Min sketch instead, populating

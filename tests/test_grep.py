@@ -100,3 +100,48 @@ def test_grep_cli(tmp_path, capsys):
     assert cli.main([str(path), "--grep", "the", "--stream",
                      "--format", "tsv"]) == 0
     assert "matches\t2" in capsys.readouterr().out
+
+
+def test_grep_checkpoint_resume(tmp_path, small_corpus):
+    """Grep's scalar state rides the generic pytree snapshot format."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import checkpoint as ckpt
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024)
+    ck = str(tmp_path / "grep.npz")
+    full = grep.grep_file(str(path), b"w1", config=cfg, mesh=data_mesh(2))
+    r1 = grep.grep_file(str(path), b"w1", config=cfg, mesh=data_mesh(2),
+                        checkpoint_path=ck, checkpoint_every=1)
+    assert ckpt.exists(ck)
+    r2 = grep.grep_file(str(path), b"w1", config=cfg, mesh=data_mesh(2),
+                        checkpoint_path=ck, checkpoint_every=1)
+    assert r1.matches == r2.matches == full.matches
+    assert r1.lines == r2.lines == full.lines
+
+    # A word-count run must refuse grep's snapshot (different structure).
+    import pytest
+    from mapreduce_tpu.runtime import executor
+
+    with pytest.raises(ckpt.CheckpointMismatch):
+        executor.count_file(str(path), config=cfg, mesh=data_mesh(2),
+                            checkpoint_path=ck, checkpoint_every=1)
+
+
+def test_grep_checkpoint_pattern_mismatch(tmp_path, small_corpus):
+    """Same state SHAPE, different pattern: the job identity in the
+    fingerprint refuses the resume (the review's silent-corruption case)."""
+    import pytest
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import checkpoint as ckpt
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024)
+    ck = str(tmp_path / "grep.npz")
+    grep.grep_file(str(path), b"w1", config=cfg, mesh=data_mesh(2),
+                   checkpoint_path=ck, checkpoint_every=1)
+    with pytest.raises(ckpt.CheckpointMismatch, match="job"):
+        grep.grep_file(str(path), b"w2", config=cfg, mesh=data_mesh(2),
+                       checkpoint_path=ck, checkpoint_every=1)
