@@ -126,3 +126,22 @@ def test_streamed_ngrams_multi_device(tmp_path, small_corpus):
     assert len(result.words) == 10
     for gram, count in result.as_dict().items():
         assert exact.get(gram, 0) >= count
+
+
+def test_ngram_checkpoint_order_mismatch(tmp_path, small_corpus):
+    """Bigram and trigram states share shapes; job identity refuses the
+    cross-resume."""
+    import pytest
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import checkpoint as ckpt
+    from mapreduce_tpu.runtime.executor import count_file
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024, table_capacity=1 << 12, backend="xla")
+    ck = str(tmp_path / "ng.npz")
+    count_file(str(path), config=cfg, mesh=data_mesh(2), ngram=2,
+               checkpoint_path=ck, checkpoint_every=1)
+    with pytest.raises(ckpt.CheckpointMismatch, match="job"):
+        count_file(str(path), config=cfg, mesh=data_mesh(2), ngram=3,
+                   checkpoint_path=ck, checkpoint_every=1)
