@@ -78,8 +78,40 @@ def _log(msg: str, t0: float) -> None:
     print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}", file=sys.stderr)
 
 
+def _arm_watchdog(seconds: int, wall0: float) -> None:
+    """Fail fast with an explicit JSON error line if the device hangs.
+
+    The bench chip sits behind a shared relay that can wedge indefinitely
+    (a killed client leaving a claimed session blocks every subsequent
+    device op, including jax.devices()).  A hung device_put is not
+    interruptible from Python, so a daemon timer hard-exits with a
+    machine-readable failure instead of silently consuming the caller's
+    entire time budget.  BENCH_WATCHDOG_S overrides (0 disables).
+    """
+    import threading
+
+    def fire():
+        _log(f"WATCHDOG: no completion after {seconds}s — device tunnel "
+             "wedged or unreachable; aborting", wall0)
+        print(json.dumps({
+            "metric": "zipf_wordcount_device_throughput",
+            "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+            "error": f"device unreachable: bench exceeded {seconds}s "
+                     "(wedged TPU relay?); see BENCHMARKS.md for last "
+                     "measured numbers",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> int:
     wall0 = time.perf_counter()
+    watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "480"))
+    if watchdog_s:
+        _arm_watchdog(watchdog_s, wall0)
     mb = int(os.environ.get("BENCH_MB", "256"))
     chunk_mb = int(os.environ.get("BENCH_CHUNK_MB", "32"))
     superstep = int(os.environ.get("BENCH_SUPERSTEP", "0"))  # 0 = all chunks
