@@ -214,3 +214,43 @@ def test_multi_file_corpus_counts_and_recovery(tmp_path, rng):
     r3 = executor.count_file(paths, config=cfg, checkpoint_path=ck,
                              checkpoint_every=2)  # resumes mid-corpus
     assert r2.as_dict() == r.as_dict() == r3.as_dict()
+
+
+def test_step_failure_is_surfaced_with_resume_cursor(tmp_path, rng):
+    """Failure detection (SURVEY §5): a failing step logs the resume cursor
+    loudly and re-raises — never a silent partial result."""
+    import logging
+
+    corpus = make_corpus(rng, 2000, 100)
+    path = _write(tmp_path, corpus)
+    mesh = data_mesh(2)
+    job = WordCountJob(CFG)
+
+    class FailingEngine(executor.Engine):
+        def step(self, state, chunks, step_index):
+            if step_index >= 2:
+                raise RuntimeError("injected device fault")
+            return super().step(state, chunks, step_index)
+
+    records: list[logging.LogRecord] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("mapreduce_tpu")  # propagate=False: attach
+    handler = Capture()
+    logger.addHandler(handler)
+    real_engine = executor.Engine
+    executor.Engine = FailingEngine
+    try:
+        with pytest.raises(RuntimeError, match="injected device fault"):
+            executor.run_job(job, path, config=CFG, mesh=mesh)
+    finally:
+        executor.Engine = real_engine
+        logger.removeHandler(handler)
+    failed = [r for r in records if "step failed" in r.getMessage()]
+    assert failed, "the failure must be logged before re-raising"
+    fields = getattr(failed[0], "fields", {})
+    assert fields.get("step") == 2  # the resume cursor names the failed step
+    assert "resume_hint" in fields
