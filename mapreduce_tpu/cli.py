@@ -31,6 +31,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="mapreduce-tpu",
         description="TPU-native MapReduce word count (reference-parity CLI).",
     )
+    from mapreduce_tpu.version import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
     p.add_argument("input", nargs="*", default=["test.txt"],
                    help="input text file(s) (default: test.txt, matching the "
                         "reference; multiple files stream as one corpus)")
