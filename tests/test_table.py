@@ -164,3 +164,56 @@ def test_packed_fast_path_matches_build_with_overflow():
             np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b), err_msg=f"{field} cap={cap}")
         assert int(fast.total_count()) == 150
+
+
+def test_merge_spill_drops_largest_keys_deterministically(rng):
+    """When a merge exceeds capacity, the spilled uniques are the largest
+    keys (sort order) — deterministic, and identical whichever side they
+    came from (commutativity under spill)."""
+    def table_of(words, cap):
+        data = (" ".join(words)).encode()
+        padded = tok.pad_to(np.frombuffer(data, np.uint8),
+                            max(128, -(-len(data) // 128) * 128))
+        return tbl.from_stream(tok.tokenize(jnp.asarray(padded)), cap)
+
+    a = table_of([f"a{i}" for i in range(40)], 64)
+    b = table_of([f"b{i}" for i in range(40)], 64)
+    cap = 48  # 80 distinct keys -> 32 spill
+    m1 = tbl.merge(a, b, capacity=cap)
+    m2 = tbl.merge(b, a, capacity=cap)
+    for f in tbl.CountTable._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(m1, f)),
+                                      np.asarray(getattr(m2, f)))
+    assert int(np.asarray(m1.dropped_uniques)) == 80 - cap
+    # Exact totals survive the spill.
+    assert int(np.asarray(m1.total_count())) == 80
+    # Kept keys are exactly the `cap` smallest of the union, sorted.
+    kept = np.asarray(m1.key_hi).astype(np.uint64) << 32 | np.asarray(m1.key_lo)
+    union = np.sort(np.concatenate([
+        (np.asarray(t.key_hi).astype(np.uint64) << 32 | np.asarray(t.key_lo))[
+            np.asarray(t.count) > 0] for t in (a, b)]))
+    np.testing.assert_array_equal(np.sort(kept), union[:cap])
+
+
+def test_merge_associativity_under_spill(rng):
+    """(a+b)+c == a+(b+c) for dropped accounting and totals even when
+    intermediate merges spill (kept-key sets can differ transiently, but
+    totals and the final kept set of smallest keys must agree)."""
+    def table_of(seed, cap=64):
+        words = [f"w{seed}_{i}" for i in range(30)]
+        data = (" ".join(words)).encode()
+        padded = tok.pad_to(np.frombuffer(data, np.uint8),
+                            max(128, -(-len(data) // 128) * 128))
+        return tbl.from_stream(tok.tokenize(jnp.asarray(padded)), cap)
+
+    a, b, c = (table_of(s) for s in "abc")
+    cap = 80  # 90 distinct -> spill of 10 at the final merge
+    ab_c = tbl.merge(tbl.merge(a, b, capacity=cap), c, capacity=cap)
+    a_bc = tbl.merge(a, tbl.merge(b, c, capacity=cap), capacity=cap)
+    assert int(np.asarray(ab_c.total_count())) == 90
+    assert int(np.asarray(a_bc.total_count())) == 90
+    # a+b fits 60<=80 and b+c fits: no intermediate spill here, so the final
+    # tables must be bit-identical.
+    for f in tbl.CountTable._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(ab_c, f)),
+                                      np.asarray(getattr(a_bc, f)))
