@@ -127,14 +127,17 @@ def cms_update(cms: jax.Array, key_hi: jax.Array, key_lo: jax.Array,
     """Add a batch of (key, count) rows into the sketch.
 
     Empty table slots carry count 0, so no validity mask is needed: adding
-    zero to an arbitrary bucket is a no-op.
+    zero to an arbitrary bucket is a no-op.  All depth rows go through ONE
+    flattened scatter-add: on TPU each scatter carries a large fixed cost
+    (BENCHMARKS.md), so one scatter of depth*n updates beats depth scatters
+    of n.
     """
     depth, width = cms.shape
-    out = cms
-    for r in range(depth):  # depth is static and small: unrolled scatters
-        bucket = _cms_bucket_jnp(key_hi, key_lo, r, width - 1)
-        out = out.at[r, bucket].add(counts.astype(jnp.uint32), mode="drop")
-    return out
+    flat_idx = jnp.concatenate([
+        _cms_bucket_jnp(key_hi, key_lo, r, width - 1) + jnp.int32(r * width)
+        for r in range(depth)])
+    updates = jnp.tile(counts.astype(jnp.uint32), depth)
+    return cms.reshape(-1).at[flat_idx].add(updates, mode="drop").reshape(depth, width)
 
 
 def cms_merge(a: jax.Array, b: jax.Array) -> jax.Array:
