@@ -100,14 +100,19 @@ def test_streamed_ngrams_single_device(tmp_path, small_corpus):
     from mapreduce_tpu.parallel.mesh import data_mesh
     from mapreduce_tpu.runtime.executor import count_file
 
+    from mapreduce_tpu.data import reader
+
     path = tmp_path / "corpus.txt"
     path.write_bytes(small_corpus)
     cfg = Config(chunk_bytes=2048, table_capacity=1 << 14, backend="xla")
     mesh = data_mesh(1)
     result = count_file(str(path), config=cfg, mesh=mesh, ngram=2)
     exact = ngram_oracle(small_corpus, 2)
-    n_chunks = -(-len(small_corpus) // 2048)
-    assert sum(exact.values()) - (n_chunks - 1) <= result.total <= sum(exact.values())
+    # Bound from the ACTUAL row count: separator-aligned cuts make rows
+    # shorter than chunk_bytes, so ceil(len/chunk) undercounts seams.
+    n_rows = sum(int((b.lengths > 0).sum())
+                 for b in reader.iter_batches(str(path), 1, cfg.chunk_bytes))
+    assert sum(exact.values()) - (n_rows - 1) <= result.total <= sum(exact.values())
     # Every reported gram + count is a true (within-chunk) gram occurrence.
     for gram, count in result.as_dict().items():
         assert exact.get(gram, 0) >= count
