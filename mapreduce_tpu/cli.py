@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: fold K chunks into one dispatch "
                         "(lax.scan) to amortize per-dispatch overhead")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
+    p.add_argument("--retry", type=int, default=0, metavar="N",
+                   help="with --stream: retry a failed device step N times "
+                        "from an in-memory known-good snapshot before "
+                        "surfacing the failure")
     p.add_argument("--distinct-sketch", action="store_true",
                    help="with --stream: carry a HyperLogLog so the distinct "
                         "count stays accurate past table capacity "
@@ -141,9 +145,16 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
                 result = grep.grep_file(
                     paths, pattern, config=config,
                     checkpoint_path=args.checkpoint,
-                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
+                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
+                    retry=args.retry)
             else:
-                result = grep.grep_bytes(data, pattern)
+                # Each file is grepped separately and summed: a newline-
+                # bearing pattern (only NUL is rejected) must not fabricate a
+                # match across the artificial seam a joined buffer would add.
+                per_file = [grep.grep_bytes(c, pattern) for c in data]
+                result = grep.GrepResult(pattern,
+                                         sum(r.matches for r in per_file),
+                                         sum(r.lines for r in per_file))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -172,8 +183,17 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"--ngram must be >= 1, got {args.ngram}")
     if (args.count_sketch or args.estimate) and not args.stream:
         parser.error("--count-sketch/--estimate require --stream")
+    if args.distinct_sketch and not args.stream:
+        # Honest failure beats a flag silently ignored: the non-stream path
+        # never consults the sketch.
+        parser.error("--distinct-sketch requires --stream")
     if args.checkpoint and not args.stream:
         parser.error("--checkpoint requires --stream")
+    if args.retry and not args.stream:
+        parser.error("--retry requires --stream (the non-stream path has no "
+                     "step dispatch to retry)")
+    if args.retry < 0:
+        parser.error(f"--retry must be >= 0, got {args.retry}")
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
@@ -199,8 +219,15 @@ def main(argv: list[str] | None = None) -> int:
                 if not args.stream:
                     chunks.append(f.read())
         # Non-stream, multi-file: files are independent token streams; join
-        # with a separator so no token merges across a file boundary.
-        data = None if args.stream else b"\n".join(chunks)
+        # with a separator so no token merges across a file boundary.  Grep
+        # keeps the per-file list instead — its patterns may contain the
+        # separator, so any join byte could fabricate cross-file matches.
+        if args.stream:
+            data = None
+        elif args.grep is not None:
+            data = chunks
+        else:
+            data = b"\n".join(chunks)
         del chunks  # don't hold a second copy of the corpus for the run
     except OSError as e:
         print(f"error: cannot read {path}: {e}", file=sys.stderr)
@@ -219,6 +246,27 @@ def main(argv: list[str] | None = None) -> int:
     # MAPREDUCE_COMPILE_CACHE overrides the location, empty disables).
     profiling.enable_compile_cache()
 
+    # Pre-flight device deadline: a wedged TPU relay hangs every device op
+    # uninterruptibly, and the reference program at least runs unattended —
+    # so when a non-CPU platform is explicitly configured, probe
+    # reachability ONCE in a bounded subprocess and fail fast with a message
+    # instead of producing zero bytes of output forever.  With JAX_PLATFORMS
+    # unset (local dev: jax resolves a local backend, nothing remote to
+    # wedge) or pinned to cpu, no probe runs and no subprocess cost is paid.
+    # MAPREDUCE_WATCHDOG_S overrides the deadline (0 disables).
+    watchdog_s = float(os.environ.get("MAPREDUCE_WATCHDOG_S", "120"))
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if watchdog_s > 0 and ambient not in ("", "cpu"):
+        from mapreduce_tpu.runtime.probe import probe_once
+
+        platform, err = probe_once(watchdog_s)
+        if platform is None:
+            print(f"error: device unreachable within {watchdog_s:.0f}s "
+                  f"({err}). Retry later, or run on the host CPU with "
+                  "JAX_PLATFORMS=cpu; MAPREDUCE_WATCHDOG_S adjusts this "
+                  "deadline (0 disables).", file=sys.stderr)
+            return 3
+
     if args.grep is not None:
         return _grep_main(args, paths, data, config, input_bytes)
 
@@ -232,7 +280,8 @@ def main(argv: list[str] | None = None) -> int:
                                 count_sketch=args.count_sketch or bool(args.estimate),
                                 ngram=args.ngram,
                                 checkpoint_path=args.checkpoint,
-                                checkpoint_every=args.checkpoint_every if args.checkpoint else 0)
+                                checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
+                                retry=args.retry)
         else:
             from mapreduce_tpu.models import wordcount
 

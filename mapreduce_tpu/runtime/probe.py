@@ -1,0 +1,101 @@
+"""Device reachability probing with hard deadlines (wedged-relay defense).
+
+The bench/dev chip sits behind a shared relay that can wedge indefinitely (a
+killed client leaving a claimed session blocks every subsequent device op,
+including ``jax.devices()``) — and a hung device op is not interruptible from
+Python.  So reachability is tested in a *subprocess* with a deadline, and the
+child is NEVER killed on timeout: killing a client mid-claim is exactly what
+wedges the relay for everyone.  A slow-but-alive probe is left running and
+re-checked on later attempts; on final give-up it is left to finish (and
+release its claim) on its own.
+
+Used by ``bench.py`` (retry/backoff before staging) and the CLI
+(pre-flight deadline so ``./main`` fails fast with a message instead of
+hanging forever — the reference program at least runs unattended,
+``main.cu:164-222``).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+PROBE_CODE = ("import jax, jax.numpy as jnp; "
+              "jnp.zeros(8).block_until_ready(); "
+              "print('PLATFORM=' + jax.devices()[0].platform)")
+
+
+def _probe_outcome(proc) -> tuple[str | None, str | None]:
+    """(platform | None, error) from a finished probe process."""
+    out, err = proc.stdout.read(), proc.stderr.read()
+    if proc.returncode != 0:
+        lines = (err or "").strip().splitlines() or ["(no stderr)"]
+        # Prefer the actual exception line over JAX's traceback-filtering
+        # notice (which lands last in filtered tracebacks).
+        msg = next((ln for ln in reversed(lines) if "Error" in ln), lines[-1])
+        return None, f"probe rc={proc.returncode}: {msg.strip()[:200]}"
+    for line in (out or "").splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1], None
+    return None, "probe printed no platform"
+
+
+def probe_once(timeout_s: float) -> tuple[str | None, str | None]:
+    """One bounded probe attempt: (platform | None, error | None).
+
+    The CLI's pre-flight check: a definitive fast failure (bad platform
+    config) and a hang (wedged relay) both surface within the deadline with
+    no retry loop.  The child is left running on timeout (see module
+    docstring).
+    """
+    proc = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"no response after {timeout_s:.0f}s; wedged TPU relay?"
+    return _probe_outcome(proc)
+
+
+def wait_for_device(budget_s: float, probe_timeout_s: float,
+                    log=None) -> tuple[str | None, list[dict]]:
+    """Probe until the device answers or the budget runs out.
+
+    Returns (platform | None, attempts): attempts is a structured record
+    (elapsed seconds, outcome) suitable for a failure report, so a wedged
+    window shows N dated retries rather than one silent death.  ``log``
+    (optional callable) receives progress strings between retries.
+    """
+    attempts: list[dict] = []
+    t_start = time.perf_counter()
+    delay, deadline = 30.0, time.monotonic() + budget_s
+    proc = None
+    while True:
+        if proc is None:
+            proc = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+        try:
+            proc.wait(timeout=min(probe_timeout_s,
+                                  max(1.0, deadline - time.monotonic())))
+        except subprocess.TimeoutExpired:
+            platform, err = None, "probe still pending (left running, not killed)"
+        else:
+            platform, err = _probe_outcome(proc)
+            proc = None  # finished: next attempt spawns fresh
+        attempts.append({"t_s": round(time.perf_counter() - t_start, 1),
+                         "ok": platform is not None,
+                         **({"platform": platform} if platform else {"error": err})})
+        if platform is not None:
+            return platform, attempts
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None, attempts
+        pause = min(delay, remaining)
+        if log is not None:
+            log(f"device probe failed ({err}); retrying in {pause:.0f}s "
+                f"({remaining:.0f}s of retry budget left)")
+        time.sleep(pause)
+        delay = min(delay * 2, 300.0)

@@ -5,26 +5,17 @@ paths are exercised on CPU without TPUs.  Must run before any jax import.
 """
 
 import os
+import sys
 
-# Force, don't setdefault: the ambient environment pins JAX_PLATFORMS to the
-# real TPU tunnel, and running the whole suite through one remote chip both
-# crawls and wedges other JAX clients.  The interpreter startup may import jax
-# before this conftest runs (sitecustomize), so env vars alone are too late for
-# jax_platforms — but the *backend* initializes lazily, so config.update plus
-# XLA_FLAGS still land as long as no jax.devices()/computation ran yet.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The force-CPU idiom (config.update after import — env vars alone are too
+# late because sitecustomize may import jax at interpreter startup) lives in
+# one place: __graft_entry__._force_cpu_mesh.  It also bumps a too-small
+# ambient xla_force_host_platform_device_count, which the old inline copy
+# here could not.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _force_cpu_mesh
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-assert len(jax.devices()) >= 8, (
-    "tests require the 8-device virtual CPU mesh; either a JAX backend was "
-    "initialized before conftest.py could configure it, or the ambient "
-    "XLA_FLAGS already pins xla_force_host_platform_device_count below 8"
-)
+jax = _force_cpu_mesh(8)
 
 import numpy as np
 import pytest

