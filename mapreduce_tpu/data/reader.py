@@ -38,6 +38,9 @@ class Batch:
     base_offsets: np.ndarray  # int64[n_shards], absolute file offset of row starts
     lengths: np.ndarray  # int64[n_shards], valid bytes per row
     step: int
+    file_index: int = 0  # which corpus member this batch came from: a batch
+    # never spans files, so jobs with cross-row state (grep's line carry) can
+    # reset at the hard file boundary
 
 
 def _aligned_cuts(buf: np.ndarray, n_shards: int, chunk_bytes: int,
@@ -142,7 +145,7 @@ def iter_batches_multi(paths, n_shards: int, chunk_bytes: int,
     sizes = [_file_size(p) for p in paths]
     step = start_step
     file_start = 0
-    for path, size in zip(paths, sizes):
+    for fi, (path, size) in enumerate(zip(paths, sizes)):
         file_end = file_start + size
         local_lo = max(0, start_offset - file_start)
         local_hi = size if end_offset is None \
@@ -154,7 +157,7 @@ def iter_batches_multi(paths, n_shards: int, chunk_bytes: int,
                                   end_offset=local_hi, use_native=use_native):
                 yield Batch(data=b.data,
                             base_offsets=b.base_offsets + file_start,
-                            lengths=b.lengths, step=b.step)
+                            lengths=b.lengths, step=b.step, file_index=fi)
                 step = b.step + 1
         file_start = file_end
 

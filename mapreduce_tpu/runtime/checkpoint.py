@@ -75,6 +75,12 @@ def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
 _FINGERPRINT_DEFAULTS = {"backend": "xla", "pallas_max_token": 0,
                          "byte_range": None}
 
+# Snapshot format version, written into __meta.  v1 (pre-versioning) stored
+# leaves under field names; v2 stores them as positional __leaf_i.  Bump on
+# any layout change so load() can name the real cause instead of misreporting
+# an old snapshot as "different state structure".
+_FORMAT = 2
+
 
 def save(path: str, state: Any, step: int, offset: int,
          bases: np.ndarray, fingerprint: dict | None = None) -> None:
@@ -94,7 +100,8 @@ def save(path: str, state: Any, step: int, offset: int,
     payload["__offset"] = np.int64(offset)
     payload["__bases"] = np.asarray(bases, dtype=np.int64)
     payload["__meta"] = np.frombuffer(
-        json.dumps(fingerprint or {}).encode(), dtype=np.uint8)
+        json.dumps({**(fingerprint or {}), "format": _FORMAT}).encode(),
+        dtype=np.uint8)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -130,6 +137,18 @@ def load(path: str, template: Any = None,
         else jax.tree.flatten(template)
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta"]).decode() or "{}") if "__meta" in z else {}
+        fmt = meta.get("format", 1)
+        if fmt > _FORMAT:
+            raise CheckpointMismatch(
+                f"checkpoint {path} was written by a newer version of this "
+                f"framework (snapshot format {fmt}, this build reads up to "
+                f"{_FORMAT}); upgrade, or delete the checkpoint")
+        legacy_keys = [k for k in z.files if not k.startswith("__")]
+        if legacy_keys:
+            raise CheckpointMismatch(
+                f"checkpoint {path} was written by an older version of this "
+                f"framework (format {fmt}: field-named leaves "
+                f"{sorted(legacy_keys)[:4]}); delete it and restart the run")
         if expect_fingerprint:
             for key, want in expect_fingerprint.items():
                 # Checkpoints written before a key joined the fingerprint get

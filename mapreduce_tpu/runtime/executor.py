@@ -51,8 +51,18 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
             logger=None, progress_every: int = 50,
-            byte_range: Optional[tuple[int, int]] = None) -> RunResult:
+            byte_range: Optional[tuple[int, int]] = None,
+            retry: int = 0) -> RunResult:
     """Stream ``path`` through ``job`` over the mesh; see module docstring.
+
+    ``retry``: retries per step group on a transient dispatch failure.  The
+    device state is donated into each step, so with ``retry > 0`` the
+    executor keeps a host-side leaf-copy of the known-good state from just
+    before the dispatch (one extra device->host fetch per group — the cost
+    of replayability) plus the still-alive host batches, rebuilds a fresh
+    sharded state from the snapshot, and re-dispatches the same group.
+    ``retry=0`` (default) surfaces the failure immediately with the resume
+    cursor; checkpoint/resume is then the recovery path.
 
     ``byte_range``: restrict ingestion to ``[lo, hi)`` — this host's slice of
     a multi-host corpus (:func:`...parallel.distributed.host_byte_range`,
@@ -65,6 +75,8 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     ``distributed.device_put_local`` and drive ``Engine.step`` directly
     (see :mod:`mapreduce_tpu.parallel.distributed`).
     """
+    if retry < 0:
+        raise ValueError(f"retry must be >= 0, got {retry}")
     logger = logger or get_logger()
     mesh = mesh if mesh is not None else data_mesh()
     # Shard over EVERY mesh axis: a 2-D ('replica','data') mesh contributes
@@ -106,23 +118,38 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     k = config.superstep
     pending: list = []
 
+    def dispatch(state, group):
+        if len(group) == 1:
+            return engine.step(state, group[0].data, group[0].step)
+        stacked = np.stack([b.data for b in group], axis=1)
+        return engine.step_many(state, stacked, group[0].step)
+
     def flush(state, group):
         """Dispatch a group of consecutive batches (one superstep, or a
         single step for a remainder group)."""
         nonlocal bytes_done, step_index, last_ckpt
-        try:
-            if len(group) == 1:
-                state = engine.step(state, group[0].data, group[0].step)
-            else:
-                stacked = np.stack([b.data for b in group], axis=1)
-                state = engine.step_many(state, stacked, group[0].step)
-        except Exception:
-            # Failure detection (SURVEY §5): device state is donated, so a
-            # failed step cannot be replayed in-process.  Surface loudly with
-            # the resume cursor; checkpoint/resume is the recovery path.
-            log_event(logger, "step failed", step=group[0].step, offset=bytes_done,
-                      resume_hint=checkpoint_path or "enable checkpointing to resume")
-            raise
+        # The dispatch donates `state`; a known-good host snapshot (taken
+        # BEFORE donation) is what makes a retry possible at all.
+        snapshot = jax.tree.map(np.asarray, state) if retry > 0 else None
+        for attempt in range(retry + 1):
+            try:
+                state = dispatch(state, group)
+                break
+            except Exception:
+                if attempt >= retry:
+                    # Failure detection (SURVEY §5): out of retries (or none
+                    # requested).  Surface loudly with the resume cursor;
+                    # checkpoint/resume is the recovery path.
+                    log_event(logger, "step failed", step=group[0].step,
+                              offset=bytes_done,
+                              resume_hint=checkpoint_path
+                              or "enable checkpointing to resume")
+                    raise
+                # Transient-failure recovery: rebuild a fresh sharded state
+                # from the snapshot and re-dispatch the same host batches.
+                log_event(logger, "step failed; retrying",
+                          step=group[0].step, attempt=attempt + 1)
+                state = jax.device_put(snapshot, engine._sharded)
         for b in group:
             bases_list.append(b.base_offsets)
             bytes_done += int(b.lengths.sum())
@@ -143,6 +170,12 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         return state
 
     timer.start("stream")
+    # Jobs with cross-row sequential state (grep's line carry) reset it at
+    # file boundaries — files are independent corpora.  Optional, duck-typed
+    # like the other hooks; transitions are rare (once per corpus member),
+    # so the early superstep flush they force costs nothing measurable.
+    boundary_hook = getattr(job, "on_input_boundary", None)
+    last_file: Optional[int] = None
     # Prefetch: host-side chunking of step N+1 overlaps device compute of
     # step N (the double-buffering of SURVEY §7 step 4).
     for batch in reader_mod.prefetch(
@@ -150,6 +183,13 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                                     start_offset=start_offset,
                                     start_step=start_step,
                                     end_offset=range_hi)):
+        if (boundary_hook is not None and last_file is not None
+                and batch.file_index != last_file):
+            if pending:
+                state = flush(state, pending)
+                pending = []
+            state = boundary_hook(state)
+        last_file = batch.file_index
         pending.append(batch)
         if len(pending) == k:
             state = flush(state, pending)

@@ -254,3 +254,73 @@ def test_step_failure_is_surfaced_with_resume_cursor(tmp_path, rng):
     fields = getattr(failed[0], "fields", {})
     assert fields.get("step") == 2  # the resume cursor names the failed step
     assert "resume_hint" in fields
+
+
+def test_checkpoint_legacy_format_named_cause(tmp_path):
+    """A pre-versioning (field-named leaves) snapshot must be rejected with
+    the real cause named, not a misleading '0 leaves' structure error."""
+    p = str(tmp_path / "legacy.npz")
+    np.savez(p, keys=np.zeros(4, np.uint32), counts=np.zeros(4, np.uint32),
+             __step=np.int64(1), __offset=np.int64(0),
+             __bases=np.zeros((1, 1), np.int64))
+    with pytest.raises(ckpt.CheckpointMismatch, match="older version"):
+        ckpt.load(p, template={"k": np.zeros(4, np.uint32)})
+
+
+def test_checkpoint_future_format_rejected(tmp_path):
+    import json as _json
+
+    p = str(tmp_path / "future.npz")
+    meta = np.frombuffer(_json.dumps({"format": 99}).encode(), dtype=np.uint8)
+    np.savez(p, __leaf_0=np.zeros(4, np.uint32), __step=np.int64(0),
+             __offset=np.int64(0), __bases=np.zeros((0, 1), np.int64),
+             __meta=meta)
+    with pytest.raises(ckpt.CheckpointMismatch, match="newer version"):
+        ckpt.load(p, template={"k": np.zeros(4, np.uint32)})
+
+
+def test_step_retry_recovers_transient_failure(tmp_path, rng, monkeypatch):
+    """VERDICT r1 #5 'done' case: an injected one-shot step failure recovers
+    via the in-memory known-good snapshot, without a checkpoint file, and
+    produces exact counts."""
+    from mapreduce_tpu.parallel.mapreduce import Engine
+
+    corpus = make_corpus(rng, n_words=3000, vocab=120)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+
+    fired = set()  # one-shot per step: the retry of step 2 must succeed
+    original = Engine.step
+
+    def flaky(self, state, chunks, step_index):
+        if step_index in (2, 5) and step_index not in fired:
+            fired.add(step_index)
+            raise RuntimeError("injected transient device failure")
+        return original(self, state, chunks, step_index)
+
+    from mapreduce_tpu.parallel import mapreduce as mr
+    monkeypatch.setattr(mr.Engine, "step", flaky)
+
+    cfg = Config(chunk_bytes=512, table_capacity=1 << 10)
+    result = executor.count_file(str(path), cfg, mesh=data_mesh(2), retry=1)
+    assert fired == {2, 5}, "injection never fired; test is vacuous"
+    want = oracle.word_counts(corpus)
+    assert result.total == oracle.total_count(corpus)
+    assert dict(zip(result.words, result.counts)) == want
+
+
+def test_step_retry_exhausted_surfaces(tmp_path, rng, monkeypatch):
+    """A persistent failure still surfaces after the retries run out."""
+    corpus = make_corpus(rng, n_words=500, vocab=50)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+
+    def always_fail(self, state, chunks, step_index):
+        raise RuntimeError("persistent device failure")
+
+    from mapreduce_tpu.parallel import mapreduce as mr
+    monkeypatch.setattr(mr.Engine, "step", always_fail)
+
+    cfg = Config(chunk_bytes=512, table_capacity=1 << 10)
+    with pytest.raises(RuntimeError, match="persistent"):
+        executor.count_file(str(path), cfg, mesh=data_mesh(2), retry=2)
