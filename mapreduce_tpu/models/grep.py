@@ -18,13 +18,30 @@ Envelope (documented, tested):
   * occurrences are **overlapping** (pattern ``aa`` occurs twice in ``aaa``);
   * a pattern containing separator bytes never matches across a chunk seam
     (the reader cuts at separators), mirroring the n-gram per-chunk envelope;
-  * a logical line split across two chunk rows may count as matching in each
-    row, so ``lines`` is exact within rows and an upper bound across them
-    (off by at most chunks - 1);
+  * ``lines`` is **exact**, including logical lines split across chunk rows:
+    every row also emits a tiny line-boundary summary (has-newline,
+    first/last segment matched), the devices share their summaries with one
+    ``all_gather`` per step (a few bytes over ICI), and a carry bit in the
+    state threads the "current open line already matched" chain across
+    steps, so a line counted in one row's trailing segment is not recounted
+    by its continuation rows.  Only the bare per-device
+    :meth:`GrepJob.map_chunk` fallback (no mesh axis available) keeps the
+    old per-row upper bound;
   * accumulators are 64-bit (uint32 lo/hi pairs with explicit carry — JAX
     default-x64 is off, so device uint64 is unavailable): counts stay exact
     past 2**32 occurrences, where a single uint32 would silently wrap on
     corpus-scale single-byte patterns.
+
+Exact-line math: rows (in file order) form a monoid chain for the one bit c =
+"the currently open line has matched so far".  A row with a newline maps any
+incoming c to its own trailing-segment match; a newline-free row is
+*transparent*: c' = c OR (row matched).  Per row, segments-with-matches
+over-counts the truth by exactly [leading segment matched AND incoming c].
+Each transfer function has the boolean-affine form c' = a | (b & c), which
+composes associatively, so each device recovers its incoming c (and its
+correction if the step's incoming carry turns out to be 1) from the gathered
+per-row (a, b) pairs with static-shape prefix products — no sequential host
+pass, no per-step device->host sync.
 """
 
 from __future__ import annotations
@@ -47,6 +64,25 @@ class GrepState(NamedTuple):
     matches_hi: jax.Array  # uint32: high word
     lines_lo: jax.Array  # uint32: lines containing >= 1 occurrence, low word
     lines_hi: jax.Array  # uint32: high word
+    line_carry: jax.Array = np.uint32(0)  # uint32 0/1: open line matched so
+    # far at this device's stream position (identical on every device — the
+    # per-step block transfer is computed from the gathered summaries)
+
+
+class GrepUpdate(NamedTuple):
+    """One row's contribution plus the seam-correction terms (all uint32).
+
+    ``lines`` assumes the step's incoming line carry is 0; ``delta`` is how
+    much to subtract if it is 1.  ``blk_a``/``blk_b`` are the whole step's
+    composed transfer c' = blk_a | (blk_b & c), identical on every device.
+    """
+
+    matches_lo: jax.Array
+    matches_hi: jax.Array
+    lines: jax.Array
+    delta: jax.Array
+    blk_a: jax.Array
+    blk_b: jax.Array
 
 
 def _add64(a_lo, a_hi, b_lo, b_hi):
@@ -74,8 +110,16 @@ def _or_reset_combine(a, b):
     return (a_f | b_f, jnp.where(b_f, b_v, a_v | b_v))
 
 
-def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
-    """One chunk's (occurrences, matching lines), as a GrepState."""
+def _row_summary(chunk: jax.Array, pattern: np.ndarray):
+    """(matches, seg_cnt, nl, first_m, last_m) for one row, all scalar.
+
+    ``seg_cnt`` counts newline-delimited segments containing >= 1 match
+    (leading and trailing partial segments included); ``nl`` = row has a
+    newline; ``first_m``/``last_m`` = the leading/trailing segment matched.
+    Padding NULs extend the trailing segment but contain no matches (NUL is
+    rejected in patterns) and no newlines, so summaries are computable on
+    the padded row directly.
+    """
     hit = _match_mask(chunk, pattern)
     newline = chunk == jnp.uint8(0x0A)
     # Exclusive segmented prefix-OR of `hit` with newline resets: True where
@@ -85,10 +129,30 @@ def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
     # (a newline position itself resets, so inc at the newline is False for
     # the next line's first position after the shift — line state never leaks)
     first_in_line = hit & ~seen_before
-    zero = jnp.zeros((), jnp.uint32)
+    nl_before = jnp.cumsum(newline) > 0  # inclusive: any newline in [0, i]
+    in_first_seg = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), ~nl_before[:-1]])  # no newline in [0, i)
+    nl_at_or_after = jnp.flip(jnp.cumsum(jnp.flip(newline)) > 0)
+    in_last_seg = ~nl_at_or_after  # no newline in [i, n)
     # Per-chunk sums fit uint32 by construction (a chunk holds < 2**32 bytes).
-    return GrepState(matches_lo=jnp.sum(hit).astype(jnp.uint32), matches_hi=zero,
-                     lines_lo=jnp.sum(first_in_line).astype(jnp.uint32), lines_hi=zero)
+    return (jnp.sum(hit).astype(jnp.uint32),
+            jnp.sum(first_in_line).astype(jnp.uint32),
+            jnp.any(newline).astype(jnp.uint32),
+            jnp.any(hit & in_first_seg).astype(jnp.uint32),
+            jnp.any(hit & in_last_seg).astype(jnp.uint32))
+
+
+def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
+    """One chunk's (occurrences, matching lines), as a GrepState.
+
+    Treats the chunk as a whole corpus: ``lines`` is the exact per-chunk
+    segment count and ``line_carry`` is the trailing open line's match bit.
+    """
+    matches, seg_cnt, nl, first_m, last_m = _row_summary(chunk, pattern)
+    zero = jnp.zeros((), jnp.uint32)
+    return GrepState(matches_lo=matches, matches_hi=zero,
+                     lines_lo=seg_cnt, lines_hi=zero,
+                     line_carry=jnp.where(nl > 0, last_m, first_m))
 
 
 class GrepJob(MapReduceJob):
@@ -114,20 +178,77 @@ class GrepJob(MapReduceJob):
 
     def init_state(self) -> GrepState:
         zero = jnp.zeros((), jnp.uint32)
-        return GrepState(zero, zero, zero, zero)
+        return GrepState(zero, zero, zero, zero, zero)
 
-    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepState:
-        return count_matches_in_chunk(chunk, self.pattern)
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepUpdate:
+        """Per-device fallback (no mesh axis): exact within the row, the old
+        upper bound across rows (delta=0 disables the seam correction)."""
+        matches, seg_cnt, _nl, _fm, _lm = _row_summary(chunk, self.pattern)
+        z = jnp.zeros((), jnp.uint32)
+        return GrepUpdate(matches, z, seg_cnt, z, z, z)
 
-    def combine(self, state: GrepState, update: GrepState) -> GrepState:
+    def map_chunk_sharded(self, chunk: jax.Array, chunk_id: jax.Array,
+                          axis, device_index: jax.Array) -> GrepUpdate:
+        """Exact matching-line counting across row seams (module docstring).
+
+        One ``all_gather`` of a 3-word summary per step; everything else is
+        static-shape elementwise math over the [D, 3] gathered block.
+        """
+        matches, seg_cnt, nl, first_m, last_m = _row_summary(chunk, self.pattern)
+        idx = device_index  # row order of the gather == Engine's row order
+        gathered = jax.lax.all_gather(
+            jnp.stack([nl, first_m, last_m]), axis_name=axis)  # [D, 3]
+        nl_g, fm_g, lm_g = gathered[:, 0], gathered[:, 1], gathered[:, 2]
+        # Row transfer c' = a | (b & c): a newline row pins c to its trailing
+        # match; a newline-free row is transparent (ORs its own match in —
+        # for such a row first==last==any match, so a = fm works for both).
+        a_row = jnp.where(nl_g > 0, lm_g, fm_g)
+        b_row = (nl_g == 0).astype(jnp.uint32)
+
+        def compose(x, y):  # y applied after x
+            ax, bx = x
+            ay, by = y
+            return (ay | (by & ax), bx & by)
+
+        a_incl, b_incl = jax.lax.associative_scan(compose, (a_row, b_row))
+        one = jnp.ones((1,), jnp.uint32)
+        zero1 = jnp.zeros((1,), jnp.uint32)
+        a_excl = jnp.concatenate([zero1, a_incl[:-1]])
+        b_excl = jnp.concatenate([one, b_incl[:-1]])
+        c_d = jnp.take(a_excl, idx)  # my incoming bit, assuming step carry 0
+        corrected = seg_cnt - (first_m & c_d)
+        # If the step's incoming carry is 1, rows whose whole prefix is
+        # transparent (b_excl) and unmatched (~a_excl) additionally see c=1.
+        delta = first_m & jnp.take(b_excl, idx) & (1 - jnp.take(a_excl, idx))
+        zero = jnp.zeros((), jnp.uint32)
+        return GrepUpdate(matches, zero, corrected, delta,
+                          a_incl[-1], b_incl[-1])
+
+    def combine(self, state: GrepState, update: GrepUpdate) -> GrepState:
         m_lo, m_hi = _add64(state.matches_lo, state.matches_hi,
                             update.matches_lo, update.matches_hi)
+        zero = jnp.zeros((), jnp.uint32)
         l_lo, l_hi = _add64(state.lines_lo, state.lines_hi,
-                            update.lines_lo, update.lines_hi)
-        return GrepState(m_lo, m_hi, l_lo, l_hi)
+                            update.lines - (state.line_carry & update.delta),
+                            zero)
+        carry = update.blk_a | (update.blk_b & state.line_carry)
+        return GrepState(m_lo, m_hi, l_lo, l_hi, carry)
+
+    def on_input_boundary(self, state: GrepState) -> GrepState:
+        """Executor hook at a corpus-member (file) boundary: files are
+        independent line streams, so the open-line carry must not leak from
+        one file's unterminated last line into the next file's first line
+        (the non-stream path greps files separately; this keeps the streamed
+        path's semantics identical)."""
+        return state._replace(line_carry=jnp.zeros_like(state.line_carry))
 
     def merge(self, a: GrepState, b: GrepState) -> GrepState:
-        return self.combine(a, b)
+        m_lo, m_hi = _add64(a.matches_lo, a.matches_hi,
+                            b.matches_lo, b.matches_hi)
+        l_lo, l_hi = _add64(a.lines_lo, a.lines_hi, b.lines_lo, b.lines_hi)
+        # Every device's carry is identical (the block transfer comes from
+        # the gathered summaries), so either operand's is fine.
+        return GrepState(m_lo, m_hi, l_lo, l_hi, a.line_carry)
 
     def identity(self) -> str:
         # The pattern IS the job: a different pattern's snapshot has the
@@ -142,7 +263,7 @@ class GrepResult(NamedTuple):
 
     pattern: bytes
     matches: int  # overlapping occurrences
-    lines: int  # matching lines (exact within chunks; see module envelope)
+    lines: int  # matching lines (exact, incl. lines split across rows)
 
 
 def _state_result(pattern: bytes, state) -> GrepResult:

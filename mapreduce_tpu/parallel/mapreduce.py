@@ -39,6 +39,21 @@ from mapreduce_tpu.parallel import collectives
 from mapreduce_tpu.parallel import mesh as mesh_mod
 
 
+def _map_with_axis(job, chunk, chunk_id, axis, device_index):
+    """Dispatch to the job's axis-aware map hook when it defines one.
+
+    Jobs are duck-typed (WordCountJob and friends don't inherit the base
+    class), so the optional hook is resolved by name at trace time.
+    ``device_index`` is the Engine's row-major linear shard index — passed
+    through so jobs never re-derive (and risk diverging from) the axis
+    linearization their gathered data is ordered by.
+    """
+    fn = getattr(job, "map_chunk_sharded", None)
+    if fn is not None:
+        return fn(chunk, chunk_id, axis, device_index)
+    return job.map_chunk(chunk, chunk_id)
+
+
 class MapReduceJob:
     """Base class for jobs.  Subclasses override the five hooks.
 
@@ -50,6 +65,18 @@ class MapReduceJob:
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> Any:
         raise NotImplementedError
+
+    def map_chunk_sharded(self, chunk: jax.Array, chunk_id: jax.Array,
+                          axis, device_index: jax.Array) -> Any:
+        """Optional axis-aware map: runs inside ``shard_map``, so it may use
+        collectives over ``axis`` (a mesh axis name or tuple of them).  Jobs
+        whose per-chunk updates need neighbor/seam context (e.g. grep's
+        exact matching-line count across row boundaries) override this; the
+        default is the plain per-device :meth:`map_chunk`.  ``device_index``
+        is the row-major linear shard index over the sharded axes (uint32
+        scalar) — it matches the row order of ``jax.lax.all_gather(...,
+        axis_name=axis)`` output."""
+        return self.map_chunk(chunk, chunk_id)
 
     def combine(self, state: Any, update: Any) -> Any:
         raise NotImplementedError
@@ -141,8 +168,9 @@ class Engine:
         def local_step(state, chunks, step):
             local = jax.tree.map(lambda x: x[0], state)
             chunk = chunks[0]
-            chunk_id = step * jnp.uint32(n) + self._device_index()
-            update = job.map_chunk(chunk, chunk_id)
+            dev = self._device_index()
+            chunk_id = step * jnp.uint32(n) + dev
+            update = _map_with_axis(job, chunk, chunk_id, axis, dev)
             new = job.combine(local, update)
             return jax.tree.map(lambda x: x[None], new)
 
@@ -168,7 +196,8 @@ class Engine:
                 chunk = jax.lax.dynamic_index_in_dim(
                     my, (j % jnp.uint32(k)).astype(jnp.int32), keepdims=False)
                 chunk_id = (step0 + j) * jnp.uint32(n) + dev
-                return job.combine(st, job.map_chunk(chunk, chunk_id)), None
+                return job.combine(
+                    st, _map_with_axis(job, chunk, chunk_id, axis, dev)), None
 
             new, _ = jax.lax.scan(
                 body, local, jnp.arange(k * repeats, dtype=jnp.uint32))
