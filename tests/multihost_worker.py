@@ -1,0 +1,92 @@
+"""Worker process for the TRUE multi-process multi-host test.
+
+Drives multi-host mode (b) from ``parallel/distributed.py``: one global SPMD
+program over the devices of every process — ``jax.distributed.initialize``,
+a global mesh, per-process staging with ``device_put_local``, the Engine's
+sharded step, and the collective finish.  Each process stages ONLY its own
+shard rows; the result is replicated to every process by the finish
+collective.
+
+Launched by ``tests/test_multihost.py::test_true_multiprocess_spmd_run``
+as N subprocesses; prints one JSON line (process 0: the counts) so the
+parent can compare against a single-process oracle run.
+
+Usage: python multihost_worker.py <process_id> <n_processes> <port> \
+    <corpus_path> <chunk_bytes> <devices_per_process>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    pid, n_proc = int(sys.argv[1]), int(sys.argv[2])
+    port, path = sys.argv[3], sys.argv[4]
+    chunk_bytes, dev_per_proc = int(sys.argv[5]), int(sys.argv[6])
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={dev_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # Cross-process CPU collectives (the CPU stand-in for ICI/DCN transport).
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from mapreduce_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=n_proc, process_id=pid, timeout_s=60)
+    assert jax.process_count() == n_proc
+    n_global = len(jax.devices())
+    assert n_global == n_proc * dev_per_proc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.data import reader
+    from mapreduce_tpu.models.wordcount import WordCountJob
+    from mapreduce_tpu.parallel.mapreduce import Engine
+
+    cfg = Config(chunk_bytes=chunk_bytes, table_capacity=1 << 10)
+    job = WordCountJob(cfg)
+    mesh = dist.global_data_mesh()
+    engine = Engine(job, mesh)
+
+    # Device-resident init: in multi-controller SPMD no process can
+    # device_put to another process's devices, so the initial state is
+    # computed BY the global program (out_shardings places it).
+    D = n_global
+
+    def init():
+        one = job.init_state()
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (D,) + x.shape), one)
+
+    state = jax.jit(init, out_shardings=engine.sharding)()
+
+    mine = list(dist.host_shards(n_global))
+    for b in reader.iter_batches(path, n_global, cfg.chunk_bytes):
+        local_rows = b.data[mine]  # this process stages ONLY its own rows
+        global_batch = dist.device_put_local(local_rows, engine.sharding)
+        state = engine.step(state, global_batch, b.step)
+
+    table = engine.finish(state)  # collective merge; replicated result
+    table = jax.tree.map(np.asarray, table)
+
+    if dist.is_coordinator():
+        live = table.count > 0
+        counts = sorted(int(c) for c in table.count[live])
+        print(json.dumps({"total": int(table.total_count()),
+                          "counts": counts,
+                          "distinct": int(live.sum()),
+                          "processes": n_proc, "devices": n_global}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
