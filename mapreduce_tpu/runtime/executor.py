@@ -124,9 +124,34 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         stacked = np.stack([b.data for b in group], axis=1)
         return engine.step_many(state, stacked, group[0].step)
 
+    def split_at_checkpoints(group):
+        """Cut a superstep group at checkpoint boundaries, so resume
+        granularity is governed by ``checkpoint_every`` even when it is
+        finer than the superstep: a crash then replays at most
+        ``checkpoint_every`` chunks per device, not a whole superstep
+        (set ``checkpoint_every >= superstep`` to keep the full dispatch
+        amortization)."""
+        if not (checkpoint_every and checkpoint_path):
+            return [group]
+        subs, cur = [], []
+        for b in group:
+            cur.append(b)
+            if (b.step + 1) % checkpoint_every == 0:
+                subs.append(cur)
+                cur = []
+        if cur:
+            subs.append(cur)
+        return subs
+
     def flush(state, group):
-        """Dispatch a group of consecutive batches (one superstep, or a
-        single step for a remainder group)."""
+        """Dispatch a group of consecutive batches (one superstep, split at
+        any interior checkpoint boundaries)."""
+        for sub in split_at_checkpoints(group):
+            state = flush_one(state, sub)
+        return state
+
+    def flush_one(state, group):
+        """Dispatch one group of consecutive batches as a single program."""
         nonlocal bytes_done, step_index, last_ckpt
         # The dispatch donates `state`; a known-good host snapshot (taken
         # BEFORE donation) is what makes a retry possible at all.
