@@ -108,12 +108,18 @@ def _count_step(data: jax.Array, capacity: int, config: Config) -> table_ops.Cou
     return _map_stream(data, config, capacity)
 
 
-def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> table_ops.CountTable:
-    """Run the device pipeline over one in-memory buffer, return the table."""
+def _pad_for_backend(data: bytes | np.ndarray, config: Config) -> np.ndarray:
+    """Pad a buffer to the backend's minimum static size (the pallas kernel
+    needs whole lane segments of >= 2W+2 bytes; XLA just needs a multiple of
+    128).  Single owner of the rule for every single-buffer entry point."""
     buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
     min_len = config.pallas_min_chunk if config.resolved_backend() == "pallas" else 128
-    padded_len = max(min_len, -(-buf.shape[0] // 128) * 128)
-    padded = tok_ops.pad_to(buf, padded_len)
+    return tok_ops.pad_to(buf, max(min_len, -(-buf.shape[0] // 128) * 128))
+
+
+def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> table_ops.CountTable:
+    """Run the device pipeline over one in-memory buffer, return the table."""
+    padded = _pad_for_backend(data, config)
     return _count_step(jax.device_put(padded), config.table_capacity, config)
 
 
@@ -142,8 +148,13 @@ def count_words(data: bytes, config: Config = DEFAULT_CONFIG) -> WordCountResult
     return recover_result(count_table(data, config), data)
 
 
-@functools.partial(jax.jit, static_argnames=("capacity", "n"))
-def _ngram_step(data: jax.Array, capacity: int, n: int) -> table_ops.CountTable:
+@functools.partial(jax.jit, static_argnames=("capacity", "n", "config"))
+def _ngram_step(data: jax.Array, capacity: int, n: int,
+                config: Config) -> table_ops.CountTable:
+    if config.resolved_backend() == "pallas":
+        from mapreduce_tpu.ops import ngram as ngram_ops
+
+        return ngram_ops.ngram_table(data, n, capacity, 0, config)
     stream = tok_ops.ngrams(tok_ops.tokenize(data), n)
     return table_ops.from_stream(stream, capacity)
 
@@ -155,9 +166,8 @@ def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCo
     between tokens included); ``total`` is the number of grams,
     ``max(tokens - n + 1, 0)``.
     """
-    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
-    padded = tok_ops.pad_to(buf, max(128, -(-buf.shape[0] // 128) * 128))
-    tbl = _ngram_step(jax.device_put(padded), config.table_capacity, n)
+    padded = _pad_for_backend(data, config)
+    tbl = _ngram_step(jax.device_put(padded), config.table_capacity, n, config)
     return recover_result(tbl, data)
 
 
@@ -226,10 +236,14 @@ class NGramCountJob(WordCountJob):
     a single-buffer run.  With multi-MB chunks this is negligible; tests pin
     the exact single-buffer semantics on a one-device mesh.
 
-    Tokenization uses the XLA segmented-scan backend: the gram pairing is a
-    carry-forward scan over the flat per-byte stream, which composes with
-    :func:`...ops.tokenize.tokenize` directly (the fused Pallas kernel's
-    split bulk/seam streams do not preserve the flat ordering pairing needs).
+    Backends: the XLA path pairs tokens with carry-forward scans over the
+    flat per-byte stream; the pallas backend sorts the fused kernel's packed
+    stream by position (one sort key recovers global token order, seam
+    emissions included, so grams straddle the kernel's 128-lane seams
+    exactly) and pairs rows elementwise — falling back to the XLA scan, per
+    chunk, only when a chunk contains overlong tokens the kernel suppressed
+    (:mod:`mapreduce_tpu.ops.ngram`).  Both backends produce bit-identical
+    tables.
     """
 
     def __init__(self, n: int, config: Config = DEFAULT_CONFIG,
@@ -241,6 +255,11 @@ class NGramCountJob(WordCountJob):
         self.k = top_k
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
+        if self.config.resolved_backend() == "pallas":
+            from mapreduce_tpu.ops import ngram as ngram_ops
+
+            return ngram_ops.ngram_table(chunk, self.n, self.batch_capacity,
+                                         chunk_id, self.config)
         stream = tok_ops.ngrams(tok_ops.tokenize(chunk), self.n)
         return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
 

@@ -1,0 +1,139 @@
+"""N-gram formation over the pallas token stream via position sort.
+
+The XLA n-gram path (:func:`mapreduce_tpu.ops.tokenize.ngrams`) pairs tokens
+with carry-forward associative scans over the flat per-byte stream — correct,
+but several log-depth passes over chunk-sized planes, and incompatible with
+the fused pallas kernel's lane-column layout (its emissions are not in byte
+order).  This module makes the pallas backend's stream pairable with ONE
+cheap sort:
+
+  * the kernel's ``packed`` plane is ``start_pos << 6 | length`` — position
+    in the high bits — so sorting the stream by ``packed`` ALONE (one sort
+    key; non-tokens carry all-ones and sink to the tail) simultaneously
+    compacts the stream and recovers global token order;
+  * gram formation is then a pure elementwise shift-by-one over adjacent
+    rows, iterated n-1 times — no scans, no carry machinery;
+  * seam-pass tokens are concatenated in BEFORE the sort, so their positions
+    interleave exactly where they belong and grams straddling the kernel's
+    128-lane seams form correctly (the XLA fallback this replaces could not
+    see the kernel's split streams at all);
+  * tokens longer than the kernel window W are *suppressed* by the kernel,
+    so two tokens adjacent in the sorted stream could straddle a suppressed
+    overlong token and pair into a gram that does not exist in the text.
+    The kernel counts overlong tokens exactly, so the wrapper falls back to
+    the XLA scan path (``lax.cond``) for precisely the chunks where
+    ``overlong > 0`` — on every other chunk the two backends agree
+    bit-for-bit, so the dispatch is semantically invisible.
+
+Hashing replicates :func:`...ops.tokenize._extend_grams` exactly (same
+composition, same fmix32 finalization, same sentinel clamp), so tables built
+from either path merge interchangeably.
+
+The reference has no n-gram capability (its map UDF emits single words only,
+``mapper`` ``main.cu:37-54``); this family is beyond-parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_tpu import constants
+from mapreduce_tpu.ops import table as table_ops
+from mapreduce_tpu.ops import tokenize as tok_ops
+from mapreduce_tpu.ops.tokenize import TokenStream
+
+# NumPy, not jnp: a module-level jnp constant is a concrete device array —
+# it initializes a backend at import time AND gets shared as a closure
+# constant across otherwise-independent jitted programs, where mismatched
+# sharding expectations break the second program's dispatch.
+_SENT_PACKED = np.uint32(0xFFFFFFFF)
+
+
+def position_sorted(stream) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort a packed-plane stream into global token order.
+
+    One single-key sort: live rows (``packed != all-ones``) come first,
+    ordered by start position (the high bits of ``packed``); sentinels sink
+    to the tail.  Returns ``(key_hi, key_lo, packed)`` in that order.
+    """
+    if stream.packed is None:
+        raise ValueError("stream has no packed plane (nonzero base_offset); "
+                         "position sort needs the raw kernel packing")
+    packed, key_hi, key_lo = jax.lax.sort(
+        (stream.packed, stream.key_hi, stream.key_lo), num_keys=1)
+    return key_hi, key_lo, packed
+
+
+def grams_from_sorted(key_hi: jax.Array, key_lo: jax.Array,
+                      packed: jax.Array, n: int) -> TokenStream:
+    """Form the n-gram stream from position-sorted token rows.
+
+    Adjacent rows are adjacent tokens, so each of the n-1 extension steps is
+    an elementwise shift-by-one + hash mix — the same recurrence as
+    :func:`...ops.tokenize._extend_grams`, minus its carry scans.
+    """
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    live = packed != _SENT_PACKED
+    start = jnp.where(live, packed >> 6, jnp.uint32(constants.POS_INF))
+    end = (packed >> 6) + (packed & jnp.uint32(63))  # exclusive token end
+
+    sentinel = jnp.uint32(constants.SENTINEL_KEY)
+    one = jnp.uint32(1)
+
+    def shift(x, fill):
+        return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+    g_hi, g_lo, g_pos, g_valid = key_hi, key_lo, start, live
+    for _ in range(n - 1):
+        p_hi = shift(g_hi, jnp.uint32(0))
+        p_lo = shift(g_lo, jnp.uint32(0))
+        p_pos = shift(g_pos, jnp.uint32(constants.POS_INF))
+        p_valid = shift(g_valid, False)
+        g_valid = live & p_valid
+        # Order-sensitive pairing, bit-identical to the XLA path.
+        g_hi = tok_ops._fmix32(p_hi * jnp.uint32(constants.HASH_BASE_1) ^ key_hi)
+        g_lo = tok_ops._fmix32(p_lo * jnp.uint32(constants.HASH_BASE_2) ^ key_lo)
+        at_sentinel = (g_hi == sentinel) & (g_lo == sentinel)
+        g_lo = jnp.where(at_sentinel, g_lo - one, g_lo)
+        g_pos = p_pos
+
+    length = jnp.where(g_valid, end - g_pos, jnp.uint32(0))
+    return TokenStream(
+        key_hi=jnp.where(g_valid, g_hi, sentinel),
+        key_lo=jnp.where(g_valid, g_lo, sentinel),
+        count=g_valid.astype(jnp.uint32),
+        pos=jnp.where(g_valid, g_pos, jnp.uint32(constants.POS_INF)),
+        length=length,
+    )
+
+
+def ngram_table(chunk: jax.Array, n: int, capacity: int,
+                pos_hi: jax.Array | int, config) -> table_ops.CountTable:
+    """Per-chunk n-gram count table on the pallas backend.
+
+    Fast path: fused kernel -> position sort -> elementwise pairing ->
+    generic table build (gram spans exceed the 6-bit packed length, so the
+    packed table fast path does not apply).  Chunks containing overlong
+    (>W-byte) tokens take the XLA scan path via ``lax.cond`` — suppressed
+    tokens would otherwise let their neighbors pair into phantom grams.
+    Both branches produce identical results on overlong-free chunks, so
+    overall semantics are exactly the XLA path's, for every chunk.
+    """
+    from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
+
+    col, seam, overlong = pallas_tok.tokenize_split(
+        chunk, max_token_bytes=config.pallas_max_token)
+    stream = pallas_tok.concat_streams(col, seam)
+
+    def fast(_):
+        gs = grams_from_sorted(*position_sorted(stream), n)
+        return table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+
+    def fallback(_):
+        gs = tok_ops.ngrams(tok_ops.tokenize(chunk), n)
+        return table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+
+    return jax.lax.cond(overlong == 0, fast, fallback, operand=None)

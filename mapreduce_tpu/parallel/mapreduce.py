@@ -180,7 +180,13 @@ class Engine:
             out_specs=P(axis),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(0,))
+        # Explicit in_shardings: without them XLA may propagate a sharding
+        # onto the 0-d step scalar (observed with data-dependent lax.cond in
+        # a job's map), and a partitioned spec on a rank-0 input breaks the
+        # second dispatch's argument resharding.
+        return jax.jit(fn, donate_argnums=(0,),
+                       in_shardings=(self._sharded, self._sharded,
+                                     self._replicated))
 
     def _build_step_many(self, k: int, repeats: int = 1):
         axis, job, n = self.axis, self.job, self.n_devices
@@ -209,7 +215,9 @@ class Engine:
             out_specs=P(axis),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(0,))
+        return jax.jit(fn, donate_argnums=(0,),
+                       in_shardings=(self._sharded, self._sharded,
+                                     self._replicated))
 
     def _build_finish(self):
         axis, job = self.axis, self.job
