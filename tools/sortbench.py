@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Microbenchmark: aggregation-sort variants on the real chip.
+
+The single-chip pipeline is sort-bound (BENCHMARKS.md: the 3-array 3-key
+sort over 16.8M pair-compacted rows costs 25-85 ms of the ~102 ms chunk
+budget).  This script times the candidate replacements in one process so
+op shares are comparable (the tunnel chip has 2-4x run-to-run variance;
+never compare wall-clock across runs).
+
+Run on the chip:  python tools/sortbench.py          (ambient axon backend)
+Run on CPU:       JAX_PLATFORMS=cpu python tools/sortbench.py
+
+Timing rules (BENCHMARKS.md "Measurement rules"): sync by fetching a real
+output element (block_until_ready is not a barrier through the tunnel),
+poison each iteration's input with the previous output so XLA cannot hoist
+or DCE the work, best-of-k.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# 16.8M default: one 32 MB chunk's pair-compacted stream.  SORTBENCH_LOG2
+# shrinks it (e.g. 20 for CPU sanity runs).
+ROWS = 1 << int(os.environ.get("SORTBENCH_LOG2", "24"))
+
+
+def bench(name, fn, args, k=5):
+    fn = jax.jit(fn)
+    out = fn(*args)
+    jax.tree.leaves(out)[0].block_until_ready()
+    np.asarray(jax.tree.leaves(out)[0])[..., :1]  # real sync
+    best = float("inf")
+    for i in range(k):
+        # Poison: fold one element of the previous output into arg 0 so
+        # iteration i's input depends on i-1's output (no hoisting).
+        poison = jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0].astype(args[0].dtype)
+        a0 = args[0].at[0].set(args[0][0] ^ poison) if args[0].dtype == jnp.uint32 \
+            else args[0]
+        t0 = time.perf_counter()
+        out = fn(a0, *args[1:])
+        np.asarray(jax.tree.leaves(out)[0])[..., :1]
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:45s} {best * 1e3:9.2f} ms")
+    return best
+
+
+def main():
+    print(f"backend: {jax.devices()[0].platform}, rows: {ROWS}")
+    rng = np.random.default_rng(0)
+    # Realistic content: ~half the rows live (Zipf-ish key skew), rest
+    # sentinel, like a real pair-compacted stream.
+    n_tok = ROWS // 2
+    zipf = rng.zipf(1.3, size=n_tok).astype(np.uint64) % 50_000
+    khi = np.full(ROWS, 0xFFFFFFFF, np.uint32)
+    klo = np.full(ROWS, 0xFFFFFFFF, np.uint32)
+    packed = np.full(ROWS, 0xFFFFFFFF, np.uint32)
+    live_idx = np.sort(rng.choice(ROWS, size=n_tok, replace=False))
+    khi[live_idx] = (zipf * 2654435761 % (1 << 32)).astype(np.uint32)
+    klo[live_idx] = (zipf * 40503 % (1 << 32)).astype(np.uint32)
+    packed[live_idx] = ((live_idx.astype(np.uint64) * 2 % (1 << 26)) << 6 | 5).astype(np.uint32)
+    khi, klo, packed = map(jnp.asarray, (khi, klo, packed))
+
+    bench("sort 3 arrays, 3 keys (baseline)",
+          lambda a, b, c: jax.lax.sort((a, b, c), num_keys=3), (khi, klo, packed))
+    bench("sort 3 arrays, 2 keys (packed as payload)",
+          lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2), (khi, klo, packed))
+    bench("sort 3 arrays, 2 keys, stable",
+          lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2, is_stable=True),
+          (khi, klo, packed))
+    bench("sort 2 arrays, 2 keys",
+          lambda a, b: jax.lax.sort((a, b), num_keys=2), (khi, klo))
+    bench("sort 3 arrays, 1 key (position sort)",
+          lambda c, a, b: jax.lax.sort((c, a, b), num_keys=1), (packed, khi, klo))
+    bench("sort 1 array, 1 key",
+          lambda a: jax.lax.sort((a,), num_keys=1), (khi,))
+
+    # Blocked: sort rows of a [K, B] view independently (axis sort).
+    for B in (1 << 10, 1 << 12, 1 << 14):
+        K = ROWS // B
+        bench(f"blocked sort [K={K}, B={B}] 3 arr 3 keys",
+              lambda a, b, c: jax.lax.sort(
+                  (a.reshape(K, B), b.reshape(K, B), c.reshape(K, B)),
+                  dimension=1, num_keys=3),
+              (khi, klo, packed))
+
+    # Segmented-min alternative to carrying packed as a sort key: sorted
+    # (khi, klo) + associative_scan min with boundary resets.
+    def seg_min(a, b, c):
+        sa, sb, sc = jax.lax.sort((a, b, c), num_keys=2)
+        boundary = (sa != jnp.concatenate([sa[:1], sa[:-1]])) | \
+                   (sb != jnp.concatenate([sb[:1], sb[:-1]]))
+
+        def combine(x, y):
+            xf, xv = x
+            yf, yv = y
+            return (xf | yf, jnp.where(yf, yv, jnp.minimum(xv, yv)))
+
+        _, m = jax.lax.associative_scan(combine, (boundary, sc))
+        return m
+
+    bench("2-key sort + segmented scan-min of packed", seg_min, (khi, klo, packed))
+
+
+if __name__ == "__main__":
+    main()
