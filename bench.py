@@ -120,37 +120,38 @@ def make_natural_corpus(n_bytes: int, seed: int = 11) -> bytes:
     ``word`` / ``word,`` / ``word.`` are distinct tokens), sentence-initial
     capitalization (more distinct casings), a heavy head of short common
     words plus a long tail of rarer coined forms, variable sentence and
-    paragraph lengths, and occasional markup-ish tokens.
+    paragraph lengths, and occasional markup-ish tokens.  Fully vectorized
+    per slab (numpy choice + np.char ops): generation must not dominate the
+    run at BENCH_MB=256+.
     """
     rng = np.random.default_rng(seed)
-    head = np.array(_COMMON, dtype=object)
-    tail = np.array([f"{a}{b}ing" if i % 3 else f"{a}{b}s"
-                     for i, (a, b) in enumerate(
-                         (head[i % len(head)], head[(i * 7 + 3) % len(head)])
-                         for i in range(20_000))], dtype=object)
+    head = np.array(_COMMON)
+    tail = np.array([f"{head[i % len(head)]}{head[(i * 7 + 3) % len(head)]}"
+                     + ("ing" if i % 3 else "s") for i in range(20_000)])
     parts: list[bytes] = []
     have = 0
+    slab_n = 200_000  # words per vectorized slab (~1.1 MB)
     while have < n_bytes:
-        slab_words = []
-        for _ in range(2_000):  # one paragraph batch per iteration
-            sent_len = int(rng.integers(4, 22))
-            picks_head = rng.integers(0, len(head), size=sent_len)
-            use_tail = rng.random(sent_len) < 0.18
-            picks_tail = rng.integers(0, len(tail), size=sent_len)
-            words = [str(tail[picks_tail[i]]) if use_tail[i]
-                     else str(head[picks_head[i]]) for i in range(sent_len)]
-            words[0] = words[0].capitalize()
-            if rng.random() < 0.08:
-                words.insert(int(rng.integers(0, sent_len)),
-                             "[[link]]" if rng.random() < 0.5 else "&quot;")
-            mid = rng.random(len(words))
-            words = [w + "," if mid[i] < 0.06 else w
-                     for i, w in enumerate(words)]
-            words[-1] += "." if rng.random() < 0.9 else "?"
-            slab_words.append(" ".join(words))
-            if rng.random() < 0.12:
-                slab_words.append("\n")
-        slab = (" ".join(slab_words) + "\n").encode()
+        words = np.where(rng.random(slab_n) < 0.18,
+                         tail[rng.integers(0, len(tail), size=slab_n)],
+                         head[rng.integers(0, len(head), size=slab_n)])
+        # Sentence ends (~every 12 words); the following word starts a
+        # sentence and is capitalized.
+        ends = rng.random(slab_n) < (1 / 12)
+        starts = np.concatenate([[True], ends[:-1]])
+        words[starts] = np.char.capitalize(words[starts])
+        # Markup-ish tokens replace ~0.5% of words.
+        mk = rng.random(slab_n) < 0.005
+        words[mk] = np.where(rng.random(int(mk.sum())) < 0.5,
+                             "[[link]]", "&quot;")
+        # Punctuation: terminal . / ? at ends, commas mid-sentence.
+        r = rng.random(slab_n)
+        suffix = np.where(ends, np.where(r < 0.9, ".", "?"),
+                          np.where(r < 0.06, ",", ""))
+        # Paragraph breaks after ~12% of sentence ends.
+        sep = np.where(ends & (rng.random(slab_n) < 0.12), "\n", " ")
+        slab = "".join(np.char.add(np.char.add(words, suffix), sep).tolist()) \
+            .encode()
         parts.append(slab)
         have += len(slab)
     return b"".join(parts)[:n_bytes].rsplit(b" ", 1)[0] + b"\n"

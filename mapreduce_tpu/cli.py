@@ -75,10 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--estimate", action="append", default=[], metavar="WORD",
                    help="report the sketch-estimated count of WORD "
                         "(repeatable; implies --count-sketch)")
-    p.add_argument("--grep", default=None, metavar="PATTERN",
+    p.add_argument("--grep", action="append", default=None, metavar="PATTERN",
                    help="count occurrences of PATTERN instead of words "
-                        "(overlapping matches + matching lines; composes "
-                        "with --stream for sharded corpora)")
+                        "(overlapping matches + exact matching lines; "
+                        "composes with --stream for sharded corpora; "
+                        "repeatable — P patterns share ONE pass over the "
+                        "corpus)")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -132,45 +134,64 @@ def _print_stats(input_bytes: int, count: int, unit: str, elapsed: float) -> Non
 
 
 def _grep_main(args, paths, data, config, input_bytes: int) -> int:
-    """--grep mode: pattern counts instead of word counts."""
+    """--grep mode: pattern counts instead of word counts.  Multiple --grep
+    flags run as ONE fused pass (one ingest, P match masks)."""
     from mapreduce_tpu.models import grep
 
     from mapreduce_tpu.runtime import profiling
 
-    pattern = args.grep.encode()
+    patterns = [g.encode() for g in args.grep]
+    kw = dict(config=config, checkpoint_path=args.checkpoint,
+              checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
+              retry=args.retry)
     t0 = time.perf_counter()
     try:
         with profiling.trace(args.profile):
-            if args.stream:
-                result = grep.grep_file(
-                    paths, pattern, config=config,
-                    checkpoint_path=args.checkpoint,
-                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
-                    retry=args.retry)
+            if args.stream and len(patterns) == 1:
+                results = [grep.grep_file(paths, patterns[0], **kw)]
+            elif args.stream:
+                results = grep.grep_file_multi(paths, patterns, **kw)
             else:
                 # Each file is grepped separately and summed: a newline-
                 # bearing pattern (only NUL is rejected) must not fabricate a
                 # match across the artificial seam a joined buffer would add.
-                per_file = [grep.grep_bytes(c, pattern) for c in data]
-                result = grep.GrepResult(pattern,
-                                         sum(r.matches for r in per_file),
-                                         sum(r.lines for r in per_file))
+                per_file = [grep.grep_bytes_multi(c, patterns) for c in data]
+                results = [grep.GrepResult(
+                    p, sum(f[i].matches for f in per_file),
+                    sum(f[i].lines for f in per_file))
+                    for i, p in enumerate(patterns)]
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0
 
     out = sys.stdout
+    multi = len(results) > 1
     if args.format == "json":
-        out.write(json.dumps({"pattern": args.grep, "matches": result.matches,
-                              "lines": result.lines}) + "\n")
+        if multi:
+            out.write(json.dumps({"patterns": [
+                {"pattern": g, "matches": r.matches, "lines": r.lines}
+                for g, r in zip(args.grep, results)]}) + "\n")
+        else:
+            out.write(json.dumps({"pattern": args.grep[0],
+                                  "matches": results[0].matches,
+                                  "lines": results[0].lines}) + "\n")
     elif args.format == "tsv":
-        out.write(f"matches\t{result.matches}\nlines\t{result.lines}\n")
+        if multi:
+            for g, r in zip(args.grep, results):
+                out.write(f"{g}\t{r.matches}\t{r.lines}\n")
+        else:
+            out.write(f"matches\t{results[0].matches}\n"
+                      f"lines\t{results[0].lines}\n")
     else:
-        out.write(f"Matches:{result.matches}\n")
-        out.write(f"Matching Lines:{result.lines}\n")
+        for g, r in zip(args.grep, results):
+            if multi:
+                out.write(f"Pattern:{g}\n")
+            out.write(f"Matches:{r.matches}\n")
+            out.write(f"Matching Lines:{r.lines}\n")
     if args.stats:
-        _print_stats(input_bytes, result.matches, "matches", elapsed)
+        _print_stats(input_bytes, sum(r.matches for r in results),
+                     "matches", elapsed)
     return 0
 
 

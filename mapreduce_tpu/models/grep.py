@@ -111,70 +111,128 @@ def _or_reset_combine(a, b):
 
 
 def _row_summary(chunk: jax.Array, pattern: np.ndarray):
-    """(matches, seg_cnt, nl, first_m, last_m) for one row, all scalar.
+    """(matches, seg_cnt, nl, first_m, last_m) for one row, all scalar —
+    the P=1 case of :func:`_row_summary_multi` (single owner of the
+    segmented-scan math)."""
+    return tuple(x[0] for x in _row_summary_multi(chunk, [pattern]))
 
-    ``seg_cnt`` counts newline-delimited segments containing >= 1 match
-    (leading and trailing partial segments included); ``nl`` = row has a
-    newline; ``first_m``/``last_m`` = the leading/trailing segment matched.
-    Padding NULs extend the trailing segment but contain no matches (NUL is
-    rejected in patterns) and no newlines, so summaries are computable on
-    the padded row directly.
+
+def _row_summary_multi(chunk: jax.Array, patterns: list[np.ndarray]):
+    """Per-row line-boundary summaries for a static pattern list.
+
+    One pass over the chunk: the P match masks are shifted-equality ANDs
+    over the same byte planes, so XLA fuses them into a single read of the
+    chunk ("one pass, many masks").  Returns [P]-shaped arrays
+    (matches, seg_cnt, nl, first_m, last_m): ``seg_cnt`` counts
+    newline-delimited segments with >= 1 match (leading/trailing partial
+    segments included), ``nl`` = row has a newline (pattern-independent,
+    broadcast to [P]), ``first_m``/``last_m`` = the leading/trailing
+    segment matched.  Padding NULs extend the trailing segment but contain
+    no matches (NUL is rejected in patterns) and no newlines, so summaries
+    are computable on the padded row directly.  The ``seen_before``
+    exclusive segmented prefix-OR marks positions whose line already
+    matched earlier (a newline resets at its own position, so line state
+    never leaks across the shift).
     """
-    hit = _match_mask(chunk, pattern)
-    newline = chunk == jnp.uint8(0x0A)
-    # Exclusive segmented prefix-OR of `hit` with newline resets: True where
-    # an earlier position in the SAME line already matched.
-    _, inc = jax.lax.associative_scan(_or_reset_combine, (newline, hit))
-    seen_before = jnp.concatenate([jnp.zeros((1,), jnp.bool_), inc[:-1]])
-    # (a newline position itself resets, so inc at the newline is False for
-    # the next line's first position after the shift — line state never leaks)
-    first_in_line = hit & ~seen_before
-    nl_before = jnp.cumsum(newline) > 0  # inclusive: any newline in [0, i]
+    hits = jnp.stack([_match_mask(chunk, p) for p in patterns])  # [P, n]
+    newline = chunk == jnp.uint8(0x0A)  # [n]
+    nl_b = jnp.broadcast_to(newline, hits.shape)
+    _, inc = jax.lax.associative_scan(_or_reset_combine, (nl_b, hits), axis=1)
+    p = hits.shape[0]
+    seen_before = jnp.concatenate(
+        [jnp.zeros((p, 1), jnp.bool_), inc[:, :-1]], axis=1)
+    first_in_line = hits & ~seen_before
+    nl_before = jnp.cumsum(newline) > 0
     in_first_seg = jnp.concatenate(
-        [jnp.ones((1,), jnp.bool_), ~nl_before[:-1]])  # no newline in [0, i)
-    nl_at_or_after = jnp.flip(jnp.cumsum(jnp.flip(newline)) > 0)
-    in_last_seg = ~nl_at_or_after  # no newline in [i, n)
-    # Per-chunk sums fit uint32 by construction (a chunk holds < 2**32 bytes).
-    return (jnp.sum(hit).astype(jnp.uint32),
-            jnp.sum(first_in_line).astype(jnp.uint32),
-            jnp.any(newline).astype(jnp.uint32),
-            jnp.any(hit & in_first_seg).astype(jnp.uint32),
-            jnp.any(hit & in_last_seg).astype(jnp.uint32))
+        [jnp.ones((1,), jnp.bool_), ~nl_before[:-1]])
+    in_last_seg = ~jnp.flip(jnp.cumsum(jnp.flip(newline)) > 0)
+    any_nl = jnp.broadcast_to(jnp.any(newline), (p,)).astype(jnp.uint32)
+    return (jnp.sum(hits, axis=1).astype(jnp.uint32),
+            jnp.sum(first_in_line, axis=1).astype(jnp.uint32),
+            any_nl,
+            jnp.any(hits & in_first_seg, axis=1).astype(jnp.uint32),
+            jnp.any(hits & in_last_seg, axis=1).astype(jnp.uint32))
 
 
-def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
-    """One chunk's (occurrences, matching lines), as a GrepState.
-
-    Treats the chunk as a whole corpus: ``lines`` is the exact per-chunk
-    segment count and ``line_carry`` is the trailing open line's match bit.
-    """
-    matches, seg_cnt, nl, first_m, last_m = _row_summary(chunk, pattern)
-    zero = jnp.zeros((), jnp.uint32)
+def _whole_buffer_state(chunk: jax.Array,
+                        patterns: list[np.ndarray]) -> GrepState:
+    """[P]-leaf GrepState treating the chunk as a whole corpus: ``lines``
+    is the exact segment count and ``line_carry`` the trailing open line's
+    match bit."""
+    matches, seg_cnt, nl, first_m, last_m = _row_summary_multi(chunk, patterns)
+    zero = jnp.zeros_like(matches)
     return GrepState(matches_lo=matches, matches_hi=zero,
                      lines_lo=seg_cnt, lines_hi=zero,
                      line_carry=jnp.where(nl > 0, last_m, first_m))
 
 
+def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
+    """One chunk's (occurrences, matching lines): the P=1 case of
+    :func:`_whole_buffer_state`, as scalar leaves."""
+    return jax.tree.map(lambda x: x[0], _whole_buffer_state(chunk, [pattern]))
+
+
+def _validate_pattern(pattern: bytes) -> np.ndarray:
+    """Single owner of the pattern rules; returns the uint8 view."""
+    if not pattern:
+        raise ValueError("grep pattern must be non-empty")
+    if len(pattern) > 256:
+        raise ValueError(f"grep pattern of {len(pattern)} bytes exceeds "
+                         "the 256-byte limit (the match mask unrolls one "
+                         "fused comparison per pattern byte)")
+    if 0 in pattern:
+        # NUL is the chunk padding byte: a NUL-bearing pattern would
+        # count phantom matches in padding tails.
+        raise ValueError("grep pattern must not contain NUL bytes")
+    return np.frombuffer(pattern, dtype=np.uint8)
+
+
+def _compose_transfer(x, y):
+    """Boolean-affine composition: y applied after x (module docstring)."""
+    ax, bx = x
+    ay, by = y
+    return (ay | (by & ax), bx & by)
+
+
+def _seam_corrected_update(matches, seg_cnt, nl, first_m, last_m,
+                           axis, device_index) -> "GrepUpdate":
+    """Shared seam-correction core for single ([] summaries) and multi
+    ([P] summaries) pattern jobs: all_gather the row summaries over the
+    mesh axis, recover this device's incoming carry bit by prefix
+    composition, and package the corrected contribution."""
+    gathered = jax.lax.all_gather(
+        jnp.stack([nl, first_m, last_m]), axis_name=axis)  # [D, 3, ...]
+    nl_g, fm_g, lm_g = gathered[:, 0], gathered[:, 1], gathered[:, 2]
+    # Row transfer c' = a | (b & c): a newline row pins c to its trailing
+    # match; a newline-free row is transparent (ORs its own match in —
+    # for such a row first==last==any match, so a = fm works for both).
+    a_row = jnp.where(nl_g > 0, lm_g, fm_g)
+    b_row = (nl_g == 0).astype(jnp.uint32)
+    a_incl, b_incl = jax.lax.associative_scan(
+        _compose_transfer, (a_row, b_row), axis=0)
+    pad = (1,) + a_incl.shape[1:]
+    a_excl = jnp.concatenate([jnp.zeros(pad, jnp.uint32), a_incl[:-1]], axis=0)
+    b_excl = jnp.concatenate([jnp.ones(pad, jnp.uint32), b_incl[:-1]], axis=0)
+    c_d = jnp.take(a_excl, device_index, axis=0)  # incoming bit, step carry 0
+    corrected = seg_cnt - (first_m & c_d)
+    # If the step's incoming carry is 1, rows whose whole prefix is
+    # transparent (b_excl) and unmatched (~a_excl) additionally see c=1.
+    delta = first_m & jnp.take(b_excl, device_index, axis=0) \
+        & (jnp.uint32(1) - c_d)
+    return GrepUpdate(matches, jnp.zeros_like(matches), corrected, delta,
+                      a_incl[-1], b_incl[-1])
+
+
 class GrepJob(MapReduceJob):
     """Pattern-occurrence counting as a :class:`MapReduceJob`.
 
-    The accumulator is four uint32 scalars, so the global reduction is the
-    degenerate (and fastest) case of the collective tree-merge: effectively
-    a ``psum`` over the mesh.
+    The accumulator is a handful of uint32 scalars, so the global reduction
+    is the degenerate (and fastest) case of the collective tree-merge:
+    effectively a ``psum`` over the mesh.
     """
 
     def __init__(self, pattern: bytes):
-        if not pattern:
-            raise ValueError("grep pattern must be non-empty")
-        if len(pattern) > 256:
-            raise ValueError(f"grep pattern of {len(pattern)} bytes exceeds "
-                             "the 256-byte limit (the match mask unrolls one "
-                             "fused comparison per pattern byte)")
-        if 0 in pattern:
-            # NUL is the chunk padding byte: a NUL-bearing pattern would
-            # count phantom matches in padding tails.
-            raise ValueError("grep pattern must not contain NUL bytes")
-        self.pattern = np.frombuffer(pattern, dtype=np.uint8)
+        self.pattern = _validate_pattern(pattern)
 
     def init_state(self) -> GrepState:
         zero = jnp.zeros((), jnp.uint32)
@@ -194,35 +252,8 @@ class GrepJob(MapReduceJob):
         One ``all_gather`` of a 3-word summary per step; everything else is
         static-shape elementwise math over the [D, 3] gathered block.
         """
-        matches, seg_cnt, nl, first_m, last_m = _row_summary(chunk, self.pattern)
-        idx = device_index  # row order of the gather == Engine's row order
-        gathered = jax.lax.all_gather(
-            jnp.stack([nl, first_m, last_m]), axis_name=axis)  # [D, 3]
-        nl_g, fm_g, lm_g = gathered[:, 0], gathered[:, 1], gathered[:, 2]
-        # Row transfer c' = a | (b & c): a newline row pins c to its trailing
-        # match; a newline-free row is transparent (ORs its own match in —
-        # for such a row first==last==any match, so a = fm works for both).
-        a_row = jnp.where(nl_g > 0, lm_g, fm_g)
-        b_row = (nl_g == 0).astype(jnp.uint32)
-
-        def compose(x, y):  # y applied after x
-            ax, bx = x
-            ay, by = y
-            return (ay | (by & ax), bx & by)
-
-        a_incl, b_incl = jax.lax.associative_scan(compose, (a_row, b_row))
-        one = jnp.ones((1,), jnp.uint32)
-        zero1 = jnp.zeros((1,), jnp.uint32)
-        a_excl = jnp.concatenate([zero1, a_incl[:-1]])
-        b_excl = jnp.concatenate([one, b_incl[:-1]])
-        c_d = jnp.take(a_excl, idx)  # my incoming bit, assuming step carry 0
-        corrected = seg_cnt - (first_m & c_d)
-        # If the step's incoming carry is 1, rows whose whole prefix is
-        # transparent (b_excl) and unmatched (~a_excl) additionally see c=1.
-        delta = first_m & jnp.take(b_excl, idx) & (1 - jnp.take(a_excl, idx))
-        zero = jnp.zeros((), jnp.uint32)
-        return GrepUpdate(matches, zero, corrected, delta,
-                          a_incl[-1], b_incl[-1])
+        summaries = _row_summary(chunk, self.pattern)
+        return _seam_corrected_update(*summaries, axis, device_index)
 
     def combine(self, state: GrepState, update: GrepUpdate) -> GrepState:
         m_lo, m_hi = _add64(state.matches_lo, state.matches_hi,
@@ -256,6 +287,46 @@ class GrepJob(MapReduceJob):
         import hashlib
 
         return "grep:" + hashlib.sha256(self.pattern.tobytes()).hexdigest()[:16]
+
+
+class MultiGrepJob(GrepJob):
+    """P patterns counted in ONE pass over the corpus (ROADMAP r1 #6).
+
+    The P match masks are shifted-equality tests over the same byte planes,
+    so XLA fuses them into a single chunk read — P patterns cost barely more
+    than one.  State leaves are [P]-shaped; since :class:`GrepState`'s
+    combine/merge/boundary math is shape-polymorphic elementwise code, the
+    accumulation, 64-bit carries, exact line counting, and collective merge
+    are all inherited unchanged.
+    """
+
+    def __init__(self, patterns):
+        if not patterns:
+            raise ValueError("need at least one grep pattern")
+        self.patterns = [_validate_pattern(p) for p in patterns]
+
+    def init_state(self) -> GrepState:
+        z = jnp.zeros((len(self.patterns),), jnp.uint32)
+        return GrepState(z, jnp.array(z), jnp.array(z), jnp.array(z),
+                         jnp.array(z))
+
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepUpdate:
+        matches, seg_cnt, _nl, _fm, _lm = _row_summary_multi(chunk, self.patterns)
+        z = jnp.zeros_like(matches)
+        return GrepUpdate(matches, z, seg_cnt, z, z, z)
+
+    def map_chunk_sharded(self, chunk: jax.Array, chunk_id: jax.Array,
+                          axis, device_index: jax.Array) -> GrepUpdate:
+        summaries = _row_summary_multi(chunk, self.patterns)
+        return _seam_corrected_update(*summaries, axis, device_index)
+
+    def identity(self) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        for p in self.patterns:
+            h.update(len(p.tobytes()).to_bytes(4, "little") + p.tobytes())
+        return f"grep{len(self.patterns)}:" + h.hexdigest()[:16]
 
 
 class GrepResult(NamedTuple):
@@ -299,3 +370,45 @@ def grep_file(path, pattern: bytes, config: Config = DEFAULT_CONFIG,
     rr = executor.run_job(GrepJob(pattern), path, config=config,
                           mesh=mesh, **kw)
     return _state_result(pattern, rr.value)
+
+
+def _multi_results(patterns: list[bytes], state) -> list[GrepResult]:
+    """Split a [P]-leaf state into per-pattern results."""
+    m_lo = np.asarray(state.matches_lo).astype(np.int64)
+    m_hi = np.asarray(state.matches_hi).astype(np.int64)
+    l_lo = np.asarray(state.lines_lo).astype(np.int64)
+    l_hi = np.asarray(state.lines_hi).astype(np.int64)
+    return [GrepResult(p, int(m_hi[i] << 32 | m_lo[i]),
+                       int(l_hi[i] << 32 | l_lo[i]))
+            for i, p in enumerate(patterns)]
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_multi_counter(patterns: tuple[bytes, ...]):
+    pats = [np.frombuffer(p, dtype=np.uint8) for p in patterns]
+    return jax.jit(lambda chunk: _whole_buffer_state(chunk, pats))
+
+
+def grep_bytes_multi(data: bytes, patterns: list[bytes]) -> list[GrepResult]:
+    """One-call multi-pattern API: P patterns, one pass over the buffer."""
+    from mapreduce_tpu.ops import tokenize as tok_ops
+
+    MultiGrepJob(patterns)  # validate via the single owner of the rules
+    buf = np.frombuffer(data, dtype=np.uint8)
+    padded = tok_ops.pad_to(buf, max(128, -(-max(buf.shape[0], 1) // 128) * 128))
+    state = _jitted_multi_counter(tuple(patterns))(padded)
+    return _multi_results(patterns, state)
+
+
+def grep_file_multi(path, patterns: list[bytes],
+                    config: Config = DEFAULT_CONFIG, mesh=None,
+                    **kw) -> list[GrepResult]:
+    """Multi-pattern counts over a file via the streaming sharded pipeline:
+    one ingest, one fused device pass, P exact (matches, lines) pairs."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import executor
+
+    mesh = mesh if mesh is not None else data_mesh()
+    rr = executor.run_job(MultiGrepJob(patterns), path, config=config,
+                          mesh=mesh, **kw)
+    return _multi_results(patterns, rr.value)

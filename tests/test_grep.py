@@ -232,3 +232,73 @@ def test_streamed_multi_file_grep_no_carry_leak(tmp_path):
                         config=Config(chunk_bytes=128))
     assert r2.matches == 2
     assert r2.lines == 2
+
+
+def test_multi_pattern_grep_matches_singles(tmp_path, small_corpus):
+    """MultiGrepJob: P patterns in one pass must equal P single runs."""
+    pats = [b"w1", b"w23", b"zqx", b"w1 w"]
+    multi = grep.grep_bytes_multi(small_corpus, pats)
+    for p, r in zip(pats, multi):
+        single = grep.grep_bytes(small_corpus, p)
+        assert (r.matches, r.lines) == (single.matches, single.lines), p
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024)
+    streamed = grep.grep_file_multi(str(path), pats, config=cfg)
+    for p, r in zip(pats, streamed):
+        single = grep.grep_file(str(path), p, config=cfg)
+        assert (r.matches, r.lines) == (single.matches, single.lines), p
+
+
+def test_multi_pattern_grep_exact_lines_across_rows(tmp_path):
+    """The [P]-shaped carry chain stays exact per pattern when lines span
+    rows (each pattern has its own open-line bit)."""
+    corpus = (b"AAA " + b"x " * 100 + b"BBB\n" +  # one long line: AAA & BBB
+              b"BBB solo\n" + b"q " * 200 + b"\n")
+    path = tmp_path / "m.txt"
+    path.write_bytes(corpus)
+    rs = grep.grep_file_multi(str(path), [b"AAA", b"BBB", b"q"],
+                              config=Config(chunk_bytes=128))
+    assert (rs[0].matches, rs[0].lines) == (1, 1)
+    assert (rs[1].matches, rs[1].lines) == (2, 2)
+    assert rs[2].lines == 1  # all q's on one (newline-terminated) line
+
+
+def test_multi_grep_checkpoint_identity(tmp_path, small_corpus):
+    """Different pattern SETS share state shapes only if P matches; the job
+    identity must still refuse cross-resume."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime import checkpoint as ckpt
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(small_corpus)
+    cfg = Config(chunk_bytes=1024)
+    ck = str(tmp_path / "g.npz")
+    grep.grep_file_multi(str(path), [b"w1", b"w2"], config=cfg,
+                         mesh=data_mesh(2), checkpoint_path=ck,
+                         checkpoint_every=1)
+    with pytest.raises(ckpt.CheckpointMismatch, match="job"):
+        grep.grep_file_multi(str(path), [b"w1", b"w3"], config=cfg,
+                             mesh=data_mesh(2), checkpoint_path=ck,
+                             checkpoint_every=1)
+
+
+def test_multi_grep_cli(tmp_path, capsys):
+    from mapreduce_tpu import cli
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"the cat sat\nthe dog\nno match here\n")
+    assert cli.main([str(path), "--grep", "the", "--grep", "cat"]) == 0
+    out = capsys.readouterr().out
+    assert "Pattern:the\nMatches:2\nMatching Lines:2\n" in out
+    assert "Pattern:cat\nMatches:1\nMatching Lines:1\n" in out
+    assert cli.main([str(path), "--grep", "the", "--grep", "dog",
+                     "--format", "json"]) == 0
+    import json as _json
+
+    obj = _json.loads(capsys.readouterr().out)
+    assert obj["patterns"][1] == {"pattern": "dog", "matches": 1, "lines": 1}
+    # Single-pattern output shape is unchanged.
+    assert cli.main([str(path), "--grep", "the"]) == 0
+    assert capsys.readouterr().out == "Matches:2\nMatching Lines:2\n"

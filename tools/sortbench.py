@@ -30,11 +30,16 @@ import numpy as np
 ROWS = 1 << int(os.environ.get("SORTBENCH_LOG2", "24"))
 
 
+def _sync(out):
+    """Fetch ONE element (sliced on device first: np.asarray on the full
+    array would ship the whole 67 MB over the tunnel inside the timing)."""
+    np.asarray(jax.tree.leaves(out)[0].ravel()[:1])
+
+
 def bench(name, fn, args, k=5):
     fn = jax.jit(fn)
     out = fn(*args)
-    jax.tree.leaves(out)[0].block_until_ready()
-    np.asarray(jax.tree.leaves(out)[0])[..., :1]  # real sync
+    _sync(out)
     best = float("inf")
     for i in range(k):
         # Poison: fold one element of the previous output into arg 0 so
@@ -44,7 +49,7 @@ def bench(name, fn, args, k=5):
             else args[0]
         t0 = time.perf_counter()
         out = fn(a0, *args[1:])
-        np.asarray(jax.tree.leaves(out)[0])[..., :1]
+        _sync(out)
         best = min(best, time.perf_counter() - t0)
     print(f"{name:45s} {best * 1e3:9.2f} ms")
     return best
