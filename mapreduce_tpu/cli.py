@@ -81,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "composes with --stream for sharded corpora; "
                         "repeatable — P patterns share ONE pass over the "
                         "corpus)")
+    p.add_argument("--grep-syntax", choices=("literal", "class"),
+                   default="literal",
+                   help="pattern syntax for --grep: 'class' enables "
+                        "regex-lite byte classes — '.' (any byte but "
+                        "newline), '[a-z0-9]', '[^...]', '\\\\x' escapes; "
+                        "fixed length, no repetition/alternation")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -141,7 +147,8 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     from mapreduce_tpu.runtime import profiling
 
     patterns = [g.encode() for g in args.grep]
-    kw = dict(config=config, checkpoint_path=args.checkpoint,
+    syntax = args.grep_syntax
+    kw = dict(config=config, syntax=syntax, checkpoint_path=args.checkpoint,
               checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
               retry=args.retry)
     t0 = time.perf_counter()
@@ -155,7 +162,8 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
                 # Each file is grepped separately and summed: a newline-
                 # bearing pattern (only NUL is rejected) must not fabricate a
                 # match across the artificial seam a joined buffer would add.
-                per_file = [grep.grep_bytes_multi(c, patterns) for c in data]
+                per_file = [grep.grep_bytes_multi(c, patterns, syntax)
+                            for c in data]
                 results = [grep.GrepResult(
                     p, sum(f[i].matches for f in per_file),
                     sum(f[i].lines for f in per_file))
@@ -215,6 +223,8 @@ def main(argv: list[str] | None = None) -> int:
                      "step dispatch to retry)")
     if args.retry < 0:
         parser.error(f"--retry must be >= 0, got {args.retry}")
+    if args.grep_syntax != "literal" and args.grep is None:
+        parser.error("--grep-syntax requires --grep")
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")

@@ -92,14 +92,113 @@ def _add64(a_lo, a_hi, b_lo, b_hi):
     return lo, a_hi + b_hi + carry
 
 
-def _match_mask(chunk: jax.Array, pattern: np.ndarray) -> jax.Array:
-    """bool[n]: True where an occurrence of ``pattern`` starts."""
-    m, n = pattern.shape[0], chunk.shape[0]
+class ClassPattern:
+    """Regex-lite pattern: one allowed byte-SET per position (ROADMAP #3).
+
+    Syntax: plain bytes match themselves; ``.`` matches any byte except
+    newline (and the NUL pad); ``[abc]`` / ``[a-z0-9]`` are classes with
+    ranges; ``[^...]`` negates (NUL stays excluded so padding can never
+    match); ``\\x`` escapes the next byte anywhere.  No repetition or
+    alternation — the pattern length is fixed, so the match mask stays ONE
+    fused elementwise pass with a couple of compares per class range
+    instead of one equality (same TPU cost shape as a literal).
+    """
+
+    def __init__(self, spec: bytes):
+        self.spec = bytes(spec)
+        self.classes: list[tuple[bool, tuple[tuple[int, int], ...]]] = []
+        i, n = 0, len(self.spec)
+        while i < n:
+            b = self.spec[i]
+            if b == 0x5C:  # backslash escape
+                if i + 1 >= n:
+                    raise ValueError("grep pattern ends with a dangling '\\'")
+                self.classes.append((False, ((self.spec[i + 1],) * 2,)))
+                i += 2
+            elif b == 0x2E:  # '.': any byte but newline (NUL auto-excluded)
+                self.classes.append((True, ((0x0A, 0x0A),)))
+                i += 1
+            elif b == 0x5B:  # '[' class
+                j = i + 1
+                negated = j < n and self.spec[j] == 0x5E
+                if negated:
+                    j += 1
+                ranges: list[tuple[int, int]] = []
+                while j < n and self.spec[j] != 0x5D:
+                    c = self.spec[j]
+                    if c == 0x5C and j + 1 < n:
+                        j += 1
+                        c = self.spec[j]
+                    if (j + 2 < n and self.spec[j + 1] == 0x2D
+                            and self.spec[j + 2] != 0x5D):
+                        hi = self.spec[j + 2]
+                        if hi == 0x5C and j + 3 < n:
+                            j += 1
+                            hi = self.spec[j + 2]
+                        if hi < c:
+                            raise ValueError(
+                                f"empty range {chr(c)}-{chr(hi)} in grep class")
+                        ranges.append((c, hi))
+                        j += 3
+                    else:
+                        ranges.append((c, c))
+                        j += 1
+                if j >= n:
+                    raise ValueError("unterminated '[' class in grep pattern")
+                if not ranges:
+                    raise ValueError("empty [] class in grep pattern")
+                self.classes.append((negated, tuple(ranges)))
+                i = j + 1
+            else:
+                self.classes.append((False, ((b,) * 2,)))
+                i += 1
+        if not self.classes:
+            raise ValueError("grep pattern must be non-empty")
+        if len(self.classes) > 256:
+            raise ValueError(f"grep pattern of {len(self.classes)} positions "
+                             "exceeds the 256-position limit")
+        for neg, ranges in self.classes:
+            if not neg and any(lo <= 0 <= hi for lo, hi in ranges):
+                raise ValueError("grep pattern must not match NUL bytes "
+                                 "(the chunk padding byte)")
+
+    def __len__(self) -> int:
+        return len(self.classes)
+
+    def tobytes(self) -> bytes:
+        """Canonical serialization (job identity / checkpoint fingerprints)."""
+        out = [b"C1"]
+        for neg, ranges in self.classes:
+            out.append(bytes([1 if neg else 0, len(ranges)]))
+            out.extend(bytes([lo, hi]) for lo, hi in ranges)
+        return b"".join(out)
+
+
+def _position_hits(window: jax.Array, cls) -> jax.Array:
+    """bool mask: window bytes allowed by one (negated, ranges) class."""
+    neg, ranges = cls
+    m = jnp.zeros(window.shape, jnp.bool_)
+    for lo, hi in ranges:
+        m = m | (window == jnp.uint8(lo)) if lo == hi else \
+            m | ((window >= jnp.uint8(lo)) & (window <= jnp.uint8(hi)))
+    if neg:
+        m = ~m & (window != jnp.uint8(0))  # padding can never match
+    return m
+
+
+def _match_mask(chunk: jax.Array, pattern) -> jax.Array:
+    """bool[n]: True where an occurrence of ``pattern`` starts.
+
+    ``pattern`` is a uint8 array (literal) or a :class:`ClassPattern`.
+    """
+    classes = pattern.classes if isinstance(pattern, ClassPattern) \
+        else [(False, ((int(b),) * 2,)) for b in pattern.tolist()]
+    m, n = len(classes), chunk.shape[0]
     if m > n:
         return jnp.zeros((n,), jnp.bool_)
     hit = jnp.ones((n - m + 1,), jnp.bool_)
-    for i, b in enumerate(pattern.tolist()):  # m is static: unrolled ANDs
-        hit = hit & (chunk[i: n - m + 1 + i] == jnp.uint8(b))
+    for i, cls in enumerate(classes):  # m is static: unrolled ANDs
+        hit = hit & _position_hits(chunk[i: n - m + 1 + i], cls)
     return jnp.concatenate([hit, jnp.zeros((m - 1,), jnp.bool_)]) if m > 1 else hit
 
 
@@ -173,7 +272,7 @@ def count_matches_in_chunk(chunk: jax.Array, pattern: np.ndarray) -> GrepState:
 
 
 def _validate_pattern(pattern: bytes) -> np.ndarray:
-    """Single owner of the pattern rules; returns the uint8 view."""
+    """Single owner of the literal-pattern rules; returns the uint8 view."""
     if not pattern:
         raise ValueError("grep pattern must be non-empty")
     if len(pattern) > 256:
@@ -185,6 +284,17 @@ def _validate_pattern(pattern: bytes) -> np.ndarray:
         # count phantom matches in padding tails.
         raise ValueError("grep pattern must not contain NUL bytes")
     return np.frombuffer(pattern, dtype=np.uint8)
+
+
+def compile_pattern(pattern: bytes, syntax: str = "literal"):
+    """Compile a pattern spec: 'literal' -> uint8 view, 'class' ->
+    :class:`ClassPattern` (regex-lite byte classes)."""
+    if syntax == "class":
+        return ClassPattern(pattern)
+    if syntax != "literal":
+        raise ValueError(f"unknown grep syntax {syntax!r} "
+                         "(expected 'literal' or 'class')")
+    return _validate_pattern(pattern)
 
 
 def _compose_transfer(x, y):
@@ -231,8 +341,8 @@ class GrepJob(MapReduceJob):
     effectively a ``psum`` over the mesh.
     """
 
-    def __init__(self, pattern: bytes):
-        self.pattern = _validate_pattern(pattern)
+    def __init__(self, pattern: bytes, syntax: str = "literal"):
+        self.pattern = compile_pattern(pattern, syntax)
 
     def init_state(self) -> GrepState:
         zero = jnp.zeros((), jnp.uint32)
@@ -283,10 +393,14 @@ class GrepJob(MapReduceJob):
 
     def identity(self) -> str:
         # The pattern IS the job: a different pattern's snapshot has the
-        # same state shape but means different counts.
+        # same state shape but means different counts.  Class patterns get
+        # a distinct prefix so a literal spelling the same bytes as a
+        # class's canonical form cannot cross-resume.
         import hashlib
 
-        return "grep:" + hashlib.sha256(self.pattern.tobytes()).hexdigest()[:16]
+        kind = "grepc" if isinstance(self.pattern, ClassPattern) else "grep"
+        return f"{kind}:" + hashlib.sha256(
+            self.pattern.tobytes()).hexdigest()[:16]
 
 
 class MultiGrepJob(GrepJob):
@@ -300,10 +414,10 @@ class MultiGrepJob(GrepJob):
     are all inherited unchanged.
     """
 
-    def __init__(self, patterns):
+    def __init__(self, patterns, syntax: str = "literal"):
         if not patterns:
             raise ValueError("need at least one grep pattern")
-        self.patterns = [_validate_pattern(p) for p in patterns]
+        self.patterns = [compile_pattern(p, syntax) for p in patterns]
 
     def init_state(self) -> GrepState:
         z = jnp.zeros((len(self.patterns),), jnp.uint32)
@@ -324,9 +438,11 @@ class MultiGrepJob(GrepJob):
         import hashlib
 
         h = hashlib.sha256()
+        kinds = ""
         for p in self.patterns:
+            kinds += "c" if isinstance(p, ClassPattern) else "l"
             h.update(len(p.tobytes()).to_bytes(4, "little") + p.tobytes())
-        return f"grep{len(self.patterns)}:" + h.hexdigest()[:16]
+        return f"grep{len(self.patterns)}{kinds[:8]}:" + h.hexdigest()[:16]
 
 
 class GrepResult(NamedTuple):
@@ -344,30 +460,31 @@ def _state_result(pattern: bytes, state) -> GrepResult:
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_counter(pattern: bytes):
+def _jitted_counter(pattern: bytes, syntax: str):
     """One compiled counter per pattern (jit caches per buffer shape)."""
-    pat = np.frombuffer(pattern, dtype=np.uint8)
+    pat = compile_pattern(pattern, syntax)
     return jax.jit(lambda c: count_matches_in_chunk(c, pat))
 
 
-def grep_bytes(data: bytes, pattern: bytes) -> GrepResult:
+def grep_bytes(data: bytes, pattern: bytes,
+               syntax: str = "literal") -> GrepResult:
     """One-call API: pattern counts for an in-memory buffer."""
     from mapreduce_tpu.ops import tokenize as tok_ops
 
-    GrepJob(pattern)  # validate pattern via the single owner of the rules
+    GrepJob(pattern, syntax)  # validate via the single owner of the rules
     buf = np.frombuffer(data, dtype=np.uint8)
     padded = tok_ops.pad_to(buf, max(128, -(-max(buf.shape[0], 1) // 128) * 128))
-    return _state_result(pattern, _jitted_counter(pattern)(padded))
+    return _state_result(pattern, _jitted_counter(pattern, syntax)(padded))
 
 
 def grep_file(path, pattern: bytes, config: Config = DEFAULT_CONFIG,
-              mesh=None, **kw) -> GrepResult:
+              mesh=None, syntax: str = "literal", **kw) -> GrepResult:
     """Pattern counts over a file via the streaming sharded pipeline."""
     from mapreduce_tpu.parallel.mesh import data_mesh
     from mapreduce_tpu.runtime import executor
 
     mesh = mesh if mesh is not None else data_mesh()
-    rr = executor.run_job(GrepJob(pattern), path, config=config,
+    rr = executor.run_job(GrepJob(pattern, syntax), path, config=config,
                           mesh=mesh, **kw)
     return _state_result(pattern, rr.value)
 
@@ -384,31 +501,32 @@ def _multi_results(patterns: list[bytes], state) -> list[GrepResult]:
 
 
 @functools.lru_cache(maxsize=16)
-def _jitted_multi_counter(patterns: tuple[bytes, ...]):
-    pats = [np.frombuffer(p, dtype=np.uint8) for p in patterns]
+def _jitted_multi_counter(patterns: tuple[bytes, ...], syntax: str):
+    pats = [compile_pattern(p, syntax) for p in patterns]
     return jax.jit(lambda chunk: _whole_buffer_state(chunk, pats))
 
 
-def grep_bytes_multi(data: bytes, patterns: list[bytes]) -> list[GrepResult]:
+def grep_bytes_multi(data: bytes, patterns: list[bytes],
+                     syntax: str = "literal") -> list[GrepResult]:
     """One-call multi-pattern API: P patterns, one pass over the buffer."""
     from mapreduce_tpu.ops import tokenize as tok_ops
 
-    MultiGrepJob(patterns)  # validate via the single owner of the rules
+    MultiGrepJob(patterns, syntax)  # validate via the single owner
     buf = np.frombuffer(data, dtype=np.uint8)
     padded = tok_ops.pad_to(buf, max(128, -(-max(buf.shape[0], 1) // 128) * 128))
-    state = _jitted_multi_counter(tuple(patterns))(padded)
+    state = _jitted_multi_counter(tuple(patterns), syntax)(padded)
     return _multi_results(patterns, state)
 
 
 def grep_file_multi(path, patterns: list[bytes],
                     config: Config = DEFAULT_CONFIG, mesh=None,
-                    **kw) -> list[GrepResult]:
+                    syntax: str = "literal", **kw) -> list[GrepResult]:
     """Multi-pattern counts over a file via the streaming sharded pipeline:
     one ingest, one fused device pass, P exact (matches, lines) pairs."""
     from mapreduce_tpu.parallel.mesh import data_mesh
     from mapreduce_tpu.runtime import executor
 
     mesh = mesh if mesh is not None else data_mesh()
-    rr = executor.run_job(MultiGrepJob(patterns), path, config=config,
+    rr = executor.run_job(MultiGrepJob(patterns, syntax), path, config=config,
                           mesh=mesh, **kw)
     return _multi_results(patterns, rr.value)

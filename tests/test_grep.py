@@ -302,3 +302,92 @@ def test_multi_grep_cli(tmp_path, capsys):
     # Single-pattern output shape is unchanged.
     assert cli.main([str(path), "--grep", "the"]) == 0
     assert capsys.readouterr().out == "Matches:2\nMatching Lines:2\n"
+
+
+# --- regex-lite byte classes (--grep-syntax class) -------------------------
+
+def re_overlapping(data: bytes, regex: bytes) -> int:
+    import re
+
+    return sum(1 for _ in re.finditer(b"(?=" + regex + b")", data, re.DOTALL))
+
+
+def re_matching_lines(data: bytes, regex: bytes) -> int:
+    import re
+
+    return sum(1 for line in data.split(b"\n")
+               if re.search(regex, line, re.DOTALL))
+
+
+@pytest.mark.parametrize("spec,regex", [
+    (b"[0-9][0-9]", rb"[0-9][0-9]"),
+    (b"w.x", rb"w[^\n\x00]x"),
+    (b"[a-cx]1", rb"[a-cx]1"),
+    (b"[^ 0-9]z", rb"[^ 0-9\x00]z"),
+    (rb"a\.b", rb"a\.b"),
+])
+def test_class_patterns_match_re_oracle(spec, regex):
+    data = (b"w1x w9x 42 73 a1 b1 c1 x1 d1 qz 9z\n"
+            b"a.b a,b axb\nw\nx 10 99 [z] .z\n")
+    r = grep.grep_bytes(data, spec, syntax="class")
+    assert r.matches == re_overlapping(data, regex), spec
+    assert r.lines == re_matching_lines(data, regex), spec
+
+
+def test_class_pattern_overlapping_and_dotall():
+    # '.' matches any byte except newline (and the NUL pad).
+    r = grep.grep_bytes(b"aaa\naaa\n", b"a.a", syntax="class")
+    assert r.matches == 2  # one per line; '.' never crosses the newline
+    r2 = grep.grep_bytes(b"aaaa\n", b"a.a", syntax="class")
+    assert r2.matches == 2  # overlapping starts at 0 and 1
+
+
+def test_class_pattern_parse_errors():
+    for bad in (b"[abc", b"[]x", b"a\\", b"[z-a]"):
+        with pytest.raises(ValueError):
+            grep.ClassPattern(bad)
+    with pytest.raises(ValueError, match="NUL"):
+        grep.ClassPattern(b"[\x00-\x05]")
+    # Negated classes are fine: NUL stays excluded automatically.
+    grep.ClassPattern(b"[^abc]")
+
+
+def test_class_pattern_streamed_matches_single_buffer(tmp_path):
+    corpus = (b"id42 and id73 overlap 1234 here\n" * 30
+              + b"no digits on this line\n" * 10)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    single = grep.grep_bytes(corpus, b"[0-9][0-9]", syntax="class")
+    streamed = grep.grep_file(str(path), b"[0-9][0-9]",
+                              config=Config(chunk_bytes=128), syntax="class")
+    assert (streamed.matches, streamed.lines) == (single.matches, single.lines)
+    assert single.matches == re_overlapping(corpus, rb"[0-9][0-9]")
+    assert single.lines == re_matching_lines(corpus, rb"[0-9][0-9]")
+
+
+def test_class_pattern_multi_and_identity(tmp_path, small_corpus):
+    """Class + multi compose; literal and class jobs for byte-identical
+    specs have distinct checkpoint identities."""
+    rs = grep.grep_bytes_multi(small_corpus, [b"w[0-9]", b"[a-z]1"],
+                               syntax="class")
+    assert rs[0].matches == re_overlapping(small_corpus, rb"w[0-9]")
+    assert rs[1].matches == re_overlapping(small_corpus, rb"[a-z]1")
+    lit = grep.GrepJob(b"w.x")  # literal dot: 3 exact bytes
+    cls = grep.GrepJob(b"w.x", syntax="class")
+    assert lit.identity() != cls.identity()
+
+
+def test_class_pattern_cli(tmp_path, capsys):
+    from mapreduce_tpu import cli
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"ab1 cd2 xyz\nno digits\n")
+    assert cli.main([str(path), "--grep", "[a-d][a-d][0-9]",
+                     "--grep-syntax", "class", "--format", "json"]) == 0
+    import json as _json
+
+    obj = _json.loads(capsys.readouterr().out)
+    assert obj["matches"] == 2 and obj["lines"] == 1
+    # --grep-syntax without --grep is an honest error.
+    with pytest.raises(SystemExit):
+        cli.main([str(path), "--grep-syntax", "class"])
