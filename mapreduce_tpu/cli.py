@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "regex-lite byte classes — '.' (any byte but "
                         "newline), '[a-z0-9]', '[^...]', '\\\\x' escapes; "
                         "fixed length, no repetition/alternation")
+    p.add_argument("--sample", type=int, default=0, metavar="K",
+                   help="report a uniform random sample of K token "
+                        "occurrences instead of counts (mergeable bottom-k "
+                        "sketch; composes with --stream; deterministic for "
+                        "a given corpus + chunking)")
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
@@ -203,6 +208,46 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     return 0
 
 
+def _sample_main(args, paths, data, config, input_bytes: int) -> int:
+    """--sample mode: uniform token sample instead of counts."""
+    from mapreduce_tpu.models import sample as sample_mod
+    from mapreduce_tpu.runtime import profiling
+
+    t0 = time.perf_counter()
+    try:
+        with profiling.trace(args.profile):
+            if args.stream:
+                result = sample_mod.sample_file(
+                    paths, args.sample, config=config,
+                    checkpoint_path=args.checkpoint,
+                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
+                    retry=args.retry)
+            else:
+                result = sample_mod.sample_bytes(data, args.sample, config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    out = sys.stdout
+    display = _decode(result.tokens)
+    if args.format == "json":
+        out.write(json.dumps({"sample": display, "k": args.sample,
+                              "total": result.total}) + "\n")
+    elif args.format == "tsv":
+        for w in display:
+            out.write(w + "\n")
+    else:
+        out.write("--------------------------\n")
+        for w in display:
+            out.write(w + "\n")
+        out.write("--------------------------\n")
+        out.write(f"Sampled:{len(display)} of {result.total}\n")
+    if args.stats:
+        _print_stats(input_bytes, result.total, "tokens", elapsed)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     import os
 
@@ -228,16 +273,21 @@ def main(argv: list[str] | None = None) -> int:
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
-    if args.grep is not None:
-        # Honest failure beats a flag silently ignored: grep mode counts
-        # pattern matches, not words, so word-count-only flags are errors.
+    if args.grep is not None or args.sample:
+        # Honest failure beats a flag silently ignored: grep/sample modes
+        # do not count words, so word-count-only flags are errors.
+        mode = "--grep" if args.grep is not None else "--sample"
         for flag, present in (("--ngram", args.ngram != 1),
                               ("--top-k", bool(args.top_k)),
                               ("--distinct-sketch", args.distinct_sketch),
                               ("--count-sketch", args.count_sketch),
                               ("--estimate", bool(args.estimate))):
             if present:
-                parser.error(f"{flag} is not supported with --grep")
+                parser.error(f"{flag} is not supported with {mode}")
+    if args.grep is not None and args.sample:
+        parser.error("--grep and --sample are mutually exclusive")
+    if args.sample < 0:
+        parser.error(f"--sample must be >= 1, got {args.sample}")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -300,6 +350,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.grep is not None:
         return _grep_main(args, paths, data, config, input_bytes)
+    if args.sample:
+        return _sample_main(args, paths, data, config, input_bytes)
 
     t0 = time.perf_counter()
     with profiling.trace(args.profile):

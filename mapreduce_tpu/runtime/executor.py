@@ -241,6 +241,16 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     return RunResult(value=value, metrics=m, bases=bases)
 
 
+def absolute_offsets(chunk_id: np.ndarray, pos: np.ndarray,
+                     bases: np.ndarray, n_devices: int) -> np.ndarray:
+    """Decode (chunk_id = step * n_devices + device, per-chunk pos) into
+    absolute corpus offsets via the recorded row bases — the single host-
+    side owner of the Engine's chunk-id linearization (every job recovering
+    source spans goes through this)."""
+    step, dev = chunk_id // n_devices, chunk_id % n_devices
+    return bases[step, dev] + pos
+
+
 def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
                       n_devices: int) -> WordCountResult:
     """Host-side string recovery for a streamed run.
@@ -255,8 +265,7 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
     pos = np.asarray(tbl.pos_lo)[valid].astype(np.int64)
     length = np.asarray(tbl.length)[valid].astype(np.int64)
     cnt = count[valid]
-    step, dev = chunk_id // n_devices, chunk_id % n_devices
-    absolute = bases[step, dev] + pos
+    absolute = absolute_offsets(chunk_id, pos, bases, n_devices)
     order = np.argsort(absolute, kind="stable")
     spans = [(int(absolute[i]), int(length[i])) for i in order]
     words = reader_mod.read_words_at_multi(path, spans)
