@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--estimate", action="append", default=[], metavar="WORD",
                    help="report the sketch-estimated count of WORD "
                         "(repeatable; implies --count-sketch)")
+    p.add_argument("--sketch-flush-every", type=int, default=1, metavar="K",
+                   help="sketched runs: stage per-chunk sketch updates and "
+                        "scatter once every K steps (amortizes the fixed "
+                        "TPU scatter cost; results are identical)")
     p.add_argument("--grep", action="append", default=None, metavar="PATTERN",
                    help="count occurrences of PATTERN instead of words "
                         "(overlapping matches + exact matching lines; "
@@ -261,6 +265,11 @@ def main(argv: list[str] | None = None) -> int:
         # Honest failure beats a flag silently ignored: the non-stream path
         # never consults the sketch.
         parser.error("--distinct-sketch requires --stream")
+    if args.sketch_flush_every != 1 and not (args.distinct_sketch
+                                             or args.count_sketch
+                                             or args.estimate):
+        parser.error("--sketch-flush-every requires a sketch flag "
+                     "(--distinct-sketch / --count-sketch / --estimate)")
     if args.checkpoint and not args.stream:
         parser.error("--checkpoint requires --stream")
     if args.retry and not args.stream:
@@ -317,7 +326,8 @@ def main(argv: list[str] | None = None) -> int:
     try:
         config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
                         backend=args.backend, superstep=args.superstep,
-                        pallas_max_token=args.max_token_bytes)
+                        pallas_max_token=args.max_token_bytes,
+                        sketch_flush_every=args.sketch_flush_every)
     except ValueError as e:
         parser.error(str(e))
 

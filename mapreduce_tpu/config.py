@@ -51,12 +51,21 @@ class Config:
     backend: str = "auto"
     pallas_max_token: int = 32
     superstep: int = 1
+    # Sketched runs (HLL/CMS): fold per-chunk sketch updates into a pending
+    # buffer and scatter once every K steps.  TPU scatters carry a large
+    # fixed cost regardless of size (BENCHMARKS.md), so K amortizes it K-fold
+    # at the price of K * batch_uniques rows of extra device state.  1 =
+    # scatter every step (the round-1 behavior).
+    sketch_flush_every: int = 1
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
             raise ValueError(f"chunk_bytes must be a multiple of 128, got {self.chunk_bytes}")
         if self.table_capacity < 2:
             raise ValueError("table_capacity must be >= 2")
+        if self.sketch_flush_every < 1:
+            raise ValueError(
+                f"sketch_flush_every must be >= 1, got {self.sketch_flush_every}")
         if self.backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.superstep < 1:

@@ -19,6 +19,7 @@ import functools
 from typing import NamedTuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
@@ -287,6 +288,26 @@ class FreqSketchedState(NamedTuple):
     cms: jax.Array  # uint32[depth, width]
 
 
+class BatchedSketchState(NamedTuple):
+    """Sketch state with a pending-update buffer (sketch_flush_every > 1).
+
+    Per-chunk key batches are staged into ``pend_*`` with a cheap
+    ``dynamic_update_slice`` and scattered into the sketch once every K
+    steps — TPU scatters carry a large fixed cost regardless of size
+    (BENCHMARKS.md), so one scatter of K batches beats K scatters.
+    ``pend_cnt`` doubles as the validity mask: flushed slots are zeroed, so
+    re-flushing (e.g. at every collective merge level) is a masked no-op
+    for both the idempotent HLL max and the additive CMS.
+    """
+
+    table: table_ops.CountTable
+    sketch: jax.Array
+    pend_hi: jax.Array  # uint32[K * batch_capacity]
+    pend_lo: jax.Array
+    pend_cnt: jax.Array
+    cursor: jax.Array  # uint32 scalar: batches staged since last flush
+
+
 class _SketchComposedJob:
     """Compose any WordCount-family job with a mergeable sketch.
 
@@ -297,6 +318,12 @@ class _SketchComposedJob:
     per-chunk batch extraction miss the sketch too (accounted in
     ``dropped_count``).
 
+    With ``config.sketch_flush_every = K > 1`` the per-step scatter is
+    batched through :class:`BatchedSketchState` (flushed at merges and in
+    finalize, so results are bit-identical to K=1); ``finalize`` always
+    returns the plain ``state_cls`` so downstream result handling never
+    sees the buffer.
+
     Subclasses set ``state_cls`` (a ``(table, sketch)`` NamedTuple) and the
     three sketch ops.
     """
@@ -306,34 +333,85 @@ class _SketchComposedJob:
     def __init__(self, base: WordCountJob):
         self.base = base
         self.config = base.config
+        self.flush_every = base.config.sketch_flush_every
 
     def _empty(self) -> jax.Array:
         raise NotImplementedError
 
-    def _update(self, sk: jax.Array, update: table_ops.CountTable) -> jax.Array:
+    def _update_arrays(self, sk: jax.Array, key_hi, key_lo, counts) -> jax.Array:
         raise NotImplementedError
+
+    def _update(self, sk: jax.Array, update: table_ops.CountTable) -> jax.Array:
+        return self._update_arrays(sk, update.key_hi, update.key_lo, update.count)
 
     def _merge(self, a: jax.Array, b: jax.Array) -> jax.Array:
         raise NotImplementedError
 
     def init_state(self):
-        return self.state_cls(self.base.init_state(), self._empty())
+        if self.flush_every == 1:
+            return self.state_cls(self.base.init_state(), self._empty())
+        n = self.flush_every * self.base.batch_capacity
+        z = jnp.zeros((n,), jnp.uint32)
+        return BatchedSketchState(self.base.init_state(), self._empty(),
+                                  z, jnp.array(z), jnp.array(z),
+                                  jnp.zeros((), jnp.uint32))
 
     def map_chunk(self, chunk, chunk_id) -> table_ops.CountTable:
         return self.base.map_chunk(chunk, chunk_id)
 
     def combine(self, state, update: table_ops.CountTable):
-        return self.state_cls(self.base.combine(state[0], update),
-                              self._update(state[1], update))
+        if self.flush_every == 1:
+            return self.state_cls(self.base.combine(state[0], update),
+                                  self._update(state[1], update))
+        table = self.base.combine(state.table, update)
+        b = update.key_hi.shape[0]
+        off = (state.cursor % jnp.uint32(self.flush_every)) * jnp.uint32(b)
+        off = off.astype(jnp.int32)
+        pend_hi = jax.lax.dynamic_update_slice(state.pend_hi, update.key_hi, (off,))
+        pend_lo = jax.lax.dynamic_update_slice(state.pend_lo, update.key_lo, (off,))
+        pend_cnt = jax.lax.dynamic_update_slice(state.pend_cnt, update.count, (off,))
+        cursor = state.cursor + jnp.uint32(1)
+
+        def flush(_):
+            sk = self._update_arrays(state.sketch, pend_hi, pend_lo, pend_cnt)
+            return sk, jnp.zeros_like(pend_cnt), jnp.zeros((), jnp.uint32)
+
+        def keep(_):
+            return state.sketch, pend_cnt, cursor
+
+        sk, pend_cnt, cursor = jax.lax.cond(
+            cursor >= jnp.uint32(self.flush_every), flush, keep, operand=None)
+        return BatchedSketchState(table, sk, pend_hi, pend_lo, pend_cnt, cursor)
+
+    def _flushed(self, st: BatchedSketchState) -> BatchedSketchState:
+        """Fold any staged rows into the sketch (masked no-op when empty)."""
+        sk = self._update_arrays(st.sketch, st.pend_hi, st.pend_lo, st.pend_cnt)
+        return BatchedSketchState(st.table, sk, st.pend_hi, st.pend_lo,
+                                  jnp.zeros_like(st.pend_cnt),
+                                  jnp.zeros((), jnp.uint32))
 
     def merge(self, a, b):
-        return self.state_cls(self.base.merge(a[0], b[0]),
-                              self._merge(a[1], b[1]))
+        if self.flush_every == 1:
+            return self.state_cls(self.base.merge(a[0], b[0]),
+                                  self._merge(a[1], b[1]))
+        fa, fb = self._flushed(a), self._flushed(b)
+        return BatchedSketchState(
+            self.base.merge(fa.table, fb.table),
+            self._merge(fa.sketch, fb.sketch),
+            fa.pend_hi, fa.pend_lo, fa.pend_cnt, fa.cursor)
 
     def finalize(self, state):
-        return self.state_cls(self.base.finalize(state[0]), state[1])
+        if self.flush_every == 1:
+            return self.state_cls(self.base.finalize(state[0]), state[1])
+        st = self._flushed(state)
+        # Downstream (executor result unwrapping, checkpoint-of-results)
+        # sees the same plain state shape as unbatched runs.
+        return self.state_cls(self.base.finalize(st.table), st.sketch)
 
     def identity(self) -> str:
+        # flush_every changes state SHAPE but not results; shapes are
+        # validated against the checkpoint leaves, so identity stays
+        # cadence-independent.
         return f"{type(self).__name__.lower()}({self.base.identity()})"
 
 
@@ -359,8 +437,8 @@ class FreqSketchedWordCountJob(_SketchComposedJob):
     def _empty(self):
         return sketch_ops.cms_empty(self.depth, self.width_log2)
 
-    def _update(self, sk, update):
-        return sketch_ops.cms_update(sk, update.key_hi, update.key_lo, update.count)
+    def _update_arrays(self, sk, key_hi, key_lo, counts):
+        return sketch_ops.cms_update(sk, key_hi, key_lo, counts)
 
     def _merge(self, a, b):
         return sketch_ops.cms_merge(a, b)
@@ -386,9 +464,8 @@ class SketchedWordCountJob(_SketchComposedJob):
     def _empty(self):
         return sketch_ops.empty(self.precision)
 
-    def _update(self, sk, update):
-        return sketch_ops.update_from_keys(
-            sk, update.key_hi, update.key_lo, update.count > 0)
+    def _update_arrays(self, sk, key_hi, key_lo, counts):
+        return sketch_ops.update_from_keys(sk, key_hi, key_lo, counts > 0)
 
     def _merge(self, a, b):
         return sketch_ops.merge(a, b)
