@@ -49,3 +49,20 @@ print(f"estimate 'quick brown' (bigram run): {r2.estimate_count(b'quick brown')}
 # Grep.
 g = grep.grep_file(path, b"quick", config=cfg)
 print(f"grep 'quick': {g.matches} matches on {g.lines} lines")
+
+# Multi-pattern grep: P patterns share ONE pass over the corpus.
+for pat, res in zip(["quick", "fox", "zebra"],
+                    grep.grep_file_multi(path, [b"quick", b"fox", b"zebra"],
+                                         config=cfg)):
+    print(f"multigrep {pat!r}: {res.matches} matches on {res.lines} lines")
+
+# Regex-lite byte classes: fixed-length per-position allowed-sets.
+c = grep.grep_file(path, b"[a-z]o[gx]", config=cfg, syntax="class")
+print(f"grep class '[a-z]o[gx]': {c.matches} matches ('dog'/'fox' tails)")
+
+# Uniform sampling: a mergeable bottom-k sketch over token occurrences.
+from mapreduce_tpu.models import sample
+
+s = sample.sample_file(path, 8, config=cfg)
+print(f"uniform sample of {len(s.tokens)} from {s.total} tokens: "
+      + " ".join(t.decode() for t in s.tokens))
