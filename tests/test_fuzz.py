@@ -84,3 +84,76 @@ def test_streamed_capacity_pressure_keeps_exact_totals(tmp_path, seed):
     for w, c in r.as_dict().items():
         assert want.get(w) == c, w
     assert r.distinct >= len(want)  # upper-bound semantics under spill
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_class_grep_vs_re(seed):
+    """Regex-lite class patterns on hostile byte content vs Python re with
+    overlapping-match semantics."""
+    import re
+
+    from mapreduce_tpu.models import grep
+
+    rng = np.random.default_rng(100 + seed)
+    data = bytes(rng.integers(1, 256, size=3000, dtype=np.uint8))
+    cases = [
+        (b"[a-z][0-9]", rb"[a-z][0-9]"),
+        (b".[A-F]", rb"[^\n\x00][A-F]"),
+        (b"[^a-z]x", rb"[^a-z\x00]x"),
+    ]
+    for spec, regex in cases:
+        r = grep.grep_bytes(data, spec, syntax="class")
+        want = sum(1 for _ in re.finditer(b"(?=" + regex + b")", data,
+                                          re.DOTALL))
+        assert r.matches == want, (seed, spec)
+        want_lines = sum(1 for line in data.split(b"\n")
+                         if re.search(regex, line, re.DOTALL))
+        assert r.lines == want_lines, (seed, spec)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_sample_totals_and_membership(tmp_path, seed):
+    """Sampling under random chunk geometries: total always exact, every
+    sampled token is a real corpus token, k honored."""
+    from tests.conftest import make_corpus
+    from mapreduce_tpu.models import sample as sample_mod
+    from mapreduce_tpu.parallel.mesh import data_mesh
+
+    rng = np.random.default_rng(200 + seed)
+    corpus = make_corpus(rng, n_words=int(rng.integers(300, 2500)),
+                         vocab=int(rng.integers(20, 300)))
+    path = tmp_path / f"s{seed}.txt"
+    path.write_bytes(corpus)
+    k = int(rng.integers(1, 60))
+    cfg = Config(chunk_bytes=128 * int(rng.integers(1, 6)),
+                 table_capacity=1 << 10)
+    r = sample_mod.sample_file(str(path), k, config=cfg,
+                               mesh=data_mesh(int(rng.integers(1, 5))))
+    assert r.total == oracle.total_count(corpus)
+    assert len(r.tokens) == min(k, r.total)
+    words = set(oracle.split_words(corpus))
+    for t in r.tokens:
+        assert t in words
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_multigrep_singles_agreement(tmp_path, seed):
+    """Random pattern sets over random corpora: the fused multi-pass must
+    equal per-pattern runs, streamed, under random geometry."""
+    from tests.conftest import make_corpus
+    from mapreduce_tpu.models import grep
+    from mapreduce_tpu.parallel.mesh import data_mesh
+
+    rng = np.random.default_rng(300 + seed)
+    corpus = make_corpus(rng, n_words=1500, vocab=80)
+    path = tmp_path / f"m{seed}.txt"
+    path.write_bytes(corpus)
+    vocab_words = [b"w%x" % i for i in range(80)]
+    pats = [vocab_words[int(i)] for i in rng.integers(0, 80, size=4)]
+    pats.append(b"\n")  # separator byte as a pattern
+    cfg = Config(chunk_bytes=128 * int(rng.integers(1, 5)))
+    mesh = data_mesh(int(rng.integers(1, 4)))
+    multi = grep.grep_file_multi(str(path), pats, config=cfg, mesh=mesh)
+    for p, r in zip(pats, multi):
+        single = grep.grep_file(str(path), p, config=cfg, mesh=mesh)
+        assert (r.matches, r.lines) == (single.matches, single.lines), p
