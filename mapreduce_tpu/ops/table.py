@@ -183,42 +183,35 @@ def _build(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int,
     )
 
 
-def _from_stream_packed(stream: TokenStream, capacity: int,
-                        pos_hi: jax.Array | int) -> CountTable:
-    """Aggregation tuned for the measured TPU cost model.
+def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
+                     total: jax.Array, capacity: int, pos_hi: jax.Array | int,
+                     len_bits: int = 6) -> CountTable:
+    """Aggregate pre-packed single-occurrence rows (the sort-lean path).
 
-    On a real chip, large (multi-million element) scatters and gathers cost
-    300-900 ms while sorts cost ~3 ms/M/array and sized-``capacity`` gathers
-    are ~free.  So instead of the generic 6-array 4-key sort plus five
-    full-length segment scatters (:func:`_build`), this path:
+    ``packed`` = ``pos << len_bits | length`` per live row (all-ones for
+    dead rows, which sorts last); the caller guarantees length fits
+    ``len_bits`` bits and pos fits the remaining 32-len_bits.  On a real
+    chip, large scatters/gathers cost 300-900 ms while sorts cost
+    ~3 ms/M/array and capacity-sized gathers are ~free, so this path:
 
-      1. packs (pos, length) into one uint32 (``pos<<6 | len``) — legal
-         because the caller guarantees len <= 63 and pos < 2**26;
-      2. sorts just 3 arrays with 3 keys — (key_hi, key_lo, packed), so the
+      1. sorts just 3 arrays with 3 keys — (key_hi, key_lo, packed), so the
          smallest pos (first occurrence) leads each key's segment;
-      3. segment-reduces with *no* full-length scatters: segment ranks from a
+      2. segment-reduces with *no* full-length scatters: segment ranks from a
          cumsum, one ``searchsorted`` of arange(capacity+1) against the rank
          array (binary search = log-n capacity-sized gathers), counts as
          rank-range differences, and per-key fields as capacity-sized gathers
          at the segment heads.
 
-    Matches :func:`_build` output bit-for-bit under its preconditions (all
-    counts in the stream are 0/1, one shared pos_hi).
+    Matches :func:`_build` output bit-for-bit under its preconditions (every
+    live row has count 1, one shared pos_hi).
     """
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
-    n = stream.key_hi.shape[0]
-    # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
-    # feed their raw plane straight into the sort — repacking from
-    # pos/length would re-stream ~67 MB/chunk through HBM for nothing.
-    packed = getattr(stream, "packed", None)
-    if packed is None:
-        is_tok = stream.count > 0
-        packed = jnp.where(is_tok, (stream.pos << 6) | stream.length,
-                           jnp.uint32(0xFFFFFFFF))
+    n = key_hi.shape[0]
+    len_mask = jnp.uint32((1 << len_bits) - 1)
 
     key_hi, key_lo, packed = jax.lax.sort(
-        (stream.key_hi, stream.key_lo, packed), num_keys=3)
+        (key_hi, key_lo, packed), num_keys=3)
     _, rank = _segment_boundaries(key_hi, key_lo)
 
     # Segment j occupies rows [head[j], head[j+1]) in sorted order.
@@ -233,21 +226,36 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     count_u = jnp.where(occupied, count_u, jnp.uint32(0))
     key_hi_u = jnp.where(occupied, key_hi_u, sent)
     key_lo_u = jnp.where(occupied, key_lo_u, sent)
-    pos_lo_u = jnp.where(occupied, packed_u >> 6, inf)
-    len_u = jnp.where(occupied, packed_u & jnp.uint32(63), jnp.uint32(0))
+    pos_lo_u = jnp.where(occupied, packed_u >> len_bits, inf)
+    len_u = jnp.where(occupied, packed_u & len_mask, jnp.uint32(0))
     pos_hi_u = jnp.where(occupied, jnp.asarray(pos_hi, jnp.uint32), inf)
 
     dropped_uniques = _overflow_accounting(key_hi, key_lo, rank, capacity)
-    # Kernel-carried exact totals skip a stream-sized reduction pass.
-    total = getattr(stream, "total", None)
-    if total is None:
-        total = jnp.sum(stream.count)
     dropped_count = total - jnp.sum(count_u)
     return CountTable(
         key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,
         pos_hi=pos_hi_u, pos_lo=pos_lo_u, length=len_u,
         dropped_uniques=dropped_uniques, dropped_count=dropped_count,
     )
+
+
+def _from_stream_packed(stream: TokenStream, capacity: int,
+                        pos_hi: jax.Array | int) -> CountTable:
+    """Packed fast path for token streams: see :func:`from_packed_rows`."""
+    # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
+    # feed their raw plane straight into the sort — repacking from
+    # pos/length would re-stream ~67 MB/chunk through HBM for nothing.
+    packed = getattr(stream, "packed", None)
+    if packed is None:
+        is_tok = stream.count > 0
+        packed = jnp.where(is_tok, (stream.pos << 6) | stream.length,
+                           jnp.uint32(0xFFFFFFFF))
+    # Kernel-carried exact totals skip a stream-sized reduction pass.
+    total = getattr(stream, "total", None)
+    if total is None:
+        total = jnp.sum(stream.count)
+    return from_packed_rows(stream.key_hi, stream.key_lo, packed, total,
+                            capacity, pos_hi, len_bits=6)
 
 
 def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
