@@ -105,7 +105,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "length)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
+                   help="'cpu' forces the run onto the host CPU even when the "
+                        "environment pins JAX to an accelerator (equivalent "
+                        "to JAX_PLATFORMS=cpu; the escape hatch when the "
+                        "device is unreachable)")
     return p
+
+
+def _apply_platform(requested: str = "auto") -> str:
+    """Force the JAX platform when the user asked for one; return the
+    EFFECTIVE platform string (lowercase) the run will use.
+
+    The environment may pin ``jax.config.jax_platforms`` at interpreter
+    startup (sitecustomize registering a remote PJRT plugin), making the
+    ``JAX_PLATFORMS`` env var alone too late — so a user request for cpu
+    (``--platform cpu`` or ``JAX_PLATFORMS=cpu``) must land via
+    ``jax.config.update`` before any device use
+    (:func:`...runtime.platform.force_cpu`, which also verifies the force
+    landed).  The return value is read from the CONFIG, not the env var:
+    the config is what JAX will actually dial, so the pre-flight probe
+    gate must agree with it.
+    """
+    import os
+
+    from mapreduce_tpu.runtime import platform as platform_mod
+
+    want = "" if requested in (None, "auto") else requested.lower()
+    if not want and os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        want = "cpu"
+    if want == "cpu":
+        platform_mod.force_cpu()
+    return platform_mod.effective_platforms()
 
 
 _CTRL_ESCAPES = str.maketrans({"\t": "\\t", "\n": "\\n", "\r": "\\r",
@@ -337,25 +368,36 @@ def main(argv: list[str] | None = None) -> int:
     # MAPREDUCE_COMPILE_CACHE overrides the location, empty disables).
     profiling.enable_compile_cache()
 
+    # Honor a cpu request (--platform cpu / JAX_PLATFORMS=cpu) BEFORE any
+    # device use, then gate the watchdog on the EFFECTIVE platform: the
+    # environment may pin jax.config.jax_platforms to a remote accelerator
+    # at interpreter startup, in which case the env var alone neither
+    # redirects the run nor predicts what JAX will dial.
+    try:
+        effective = _apply_platform(args.platform)
+    except RuntimeError as e:  # cpu force could not land (backend already up)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
     # Pre-flight device deadline: a wedged TPU relay hangs every device op
     # uninterruptibly, and the reference program at least runs unattended —
-    # so when a non-CPU platform is explicitly configured, probe
+    # so when a non-CPU platform is effectively configured, probe
     # reachability ONCE in a bounded subprocess and fail fast with a message
-    # instead of producing zero bytes of output forever.  With JAX_PLATFORMS
-    # unset (local dev: jax resolves a local backend, nothing remote to
-    # wedge) or pinned to cpu, no probe runs and no subprocess cost is paid.
+    # instead of producing zero bytes of output forever.  With no platform
+    # configured (local dev: jax resolves a local backend, nothing remote to
+    # wedge) or cpu forced, no probe runs and no subprocess cost is paid.
     # MAPREDUCE_WATCHDOG_S overrides the deadline (0 disables).
     watchdog_s = float(os.environ.get("MAPREDUCE_WATCHDOG_S", "120"))
-    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
-    if watchdog_s > 0 and ambient not in ("", "cpu"):
+    if watchdog_s > 0 and effective not in ("", "cpu"):
         from mapreduce_tpu.runtime.probe import probe_once
 
-        platform, err = probe_once(watchdog_s)
+        platform, err = probe_once(watchdog_s, platforms=effective)
         if platform is None:
             print(f"error: device unreachable within {watchdog_s:.0f}s "
                   f"({err}). Retry later, or run on the host CPU with "
-                  "JAX_PLATFORMS=cpu; MAPREDUCE_WATCHDOG_S adjusts this "
-                  "deadline (0 disables).", file=sys.stderr)
+                  "--platform cpu (or JAX_PLATFORMS=cpu); "
+                  "MAPREDUCE_WATCHDOG_S adjusts this deadline (0 disables).",
+                  file=sys.stderr)
             return 3
 
     if args.grep is not None:

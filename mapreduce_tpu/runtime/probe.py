@@ -17,6 +17,7 @@ hanging forever — the reference program at least runs unattended,
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import time
@@ -24,6 +25,18 @@ import time
 PROBE_CODE = ("import jax, jax.numpy as jnp; "
               "jnp.zeros(8).block_until_ready(); "
               "print('PLATFORM=' + jax.devices()[0].platform)")
+
+
+def _spawn(platforms: str | None):
+    """Launch one probe child.  ``platforms`` pins the child's JAX_PLATFORMS
+    so the probe dials the same platform the caller's run will — the caller's
+    pin may live only in jax.config (in-process), which a child inheriting
+    the bare env would not see."""
+    env = None if platforms is None else {**os.environ,
+                                          "JAX_PLATFORMS": platforms}
+    return subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env)
 
 
 def _probe_outcome(proc) -> tuple[str | None, str | None]:
@@ -41,7 +54,8 @@ def _probe_outcome(proc) -> tuple[str | None, str | None]:
     return None, "probe printed no platform"
 
 
-def probe_once(timeout_s: float) -> tuple[str | None, str | None]:
+def probe_once(timeout_s: float,
+               platforms: str | None = None) -> tuple[str | None, str | None]:
     """One bounded probe attempt: (platform | None, error | None).
 
     The CLI's pre-flight check: a definitive fast failure (bad platform
@@ -49,9 +63,7 @@ def probe_once(timeout_s: float) -> tuple[str | None, str | None]:
     no retry loop.  The child is left running on timeout (see module
     docstring).
     """
-    proc = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            text=True)
+    proc = _spawn(platforms)
     try:
         proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -60,8 +72,17 @@ def probe_once(timeout_s: float) -> tuple[str | None, str | None]:
 
 
 def wait_for_device(budget_s: float, probe_timeout_s: float,
-                    log=None) -> tuple[str | None, list[dict]]:
+                    log=None, platforms: str | None = None
+                    ) -> tuple[str | None, list[dict]]:
     """Probe until the device answers or the budget runs out.
+
+    EVERY attempt spawns a fresh probe child (VERDICT round 2: re-waiting on
+    one hung child turns the whole budget into N observations of the same
+    wedged claim, so a relay that recovers mid-budget is never caught).
+    Hung children are left running, never killed — killing a client
+    mid-claim is what wedges the relay — and any of them finishing
+    successfully counts: before each verdict the older pending probes are
+    polled too.
 
     Returns (platform | None, attempts): attempts is a structured record
     (elapsed seconds, outcome) suitable for a failure report, so a wedged
@@ -71,20 +92,31 @@ def wait_for_device(budget_s: float, probe_timeout_s: float,
     attempts: list[dict] = []
     t_start = time.perf_counter()
     delay, deadline = 30.0, time.monotonic() + budget_s
-    proc = None
+    pending: list = []
+    spawned = 0
     while True:
-        if proc is None:
-            proc = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
-                                    stdout=subprocess.PIPE,
-                                    stderr=subprocess.PIPE, text=True)
+        proc = _spawn(platforms)
+        pending.append(proc)
+        spawned += 1
         try:
             proc.wait(timeout=min(probe_timeout_s,
                                   max(1.0, deadline - time.monotonic())))
         except subprocess.TimeoutExpired:
-            platform, err = None, "probe still pending (left running, not killed)"
+            platform, err = None, (
+                f"probe still pending ({spawned} spawned so far, "
+                f"{len(pending)} unfinished, left running, not killed)")
         else:
             platform, err = _probe_outcome(proc)
-            proc = None  # finished: next attempt spawns fresh
+            pending.remove(proc)
+        if platform is None:
+            # An OLDER probe may have gotten through while we waited on the
+            # newest (e.g. the relay drained its claim queue in order).
+            for p in [p for p in pending if p.poll() is not None]:
+                pending.remove(p)
+                got, _ = _probe_outcome(p)
+                if got is not None:
+                    platform, err = got, None
+                    break
         attempts.append({"t_s": round(time.perf_counter() - t_start, 1),
                          "ok": platform is not None,
                          **({"platform": platform} if platform else {"error": err})})

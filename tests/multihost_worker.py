@@ -27,12 +27,15 @@ def main() -> int:
     port, path = sys.argv[3], sys.argv[4]
     chunk_bytes, dev_per_proc = int(sys.argv[5]), int(sys.argv[6])
 
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    # EXACTLY dev_per_proc local devices (force_cpu's min_devices would keep
+    # a larger ambient count, breaking the n_proc * dev_per_proc global mesh).
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={dev_per_proc}")
-    import jax
+    from mapreduce_tpu.runtime.platform import force_cpu
 
-    jax.config.update("jax_platforms", "cpu")
+    # verify=False: jax.distributed.initialize() below must run on a pristine
+    # runtime; the platform assertions after it cover verification.
+    jax = force_cpu(verify=False)
     # Cross-process CPU collectives (the CPU stand-in for ICI/DCN transport).
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 

@@ -159,3 +159,62 @@ def test_cli_fails_fast_when_device_unreachable(tmp_path):
     )
     assert r.returncode == 3
     assert "device unreachable" in r.stderr
+
+
+def test_cpu_escape_hatch_overrides_pinned_platform_config(tmp_path):
+    """JAX_PLATFORMS=cpu must reach the host CPU even when something pinned
+    jax.config.jax_platforms to a remote platform at interpreter startup
+    (VERDICT round 2: the recommended escape hatch hung forever because the
+    watchdog gate read only the env var while the run dialed the pinned
+    config).  Simulates the sitecustomize pin, then runs the full CLI."""
+    fixture = tmp_path / "test.txt"
+    fixture.write_text("Hello World EveryOne\nWorld Good News\nGood Morning Hello\n")
+    code = (
+        "import jax\n"
+        # Simulated sitecustomize: pins a platform that does not exist, so
+        # any device use that honors the pin fails loudly (and without the
+        # fix, a REAL pin would hang on the wedged relay instead).
+        "jax.config.update('jax_platforms', 'nosuchplatform,cpu')\n"
+        "from mapreduce_tpu.cli import main\n"
+        f"raise SystemExit(main([{str(fixture)!r}]))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root",
+             "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)},
+    )
+    assert r.returncode == 0, r.stderr
+    assert "Total Count:9" in r.stdout
+
+
+def test_platform_flag_forces_cpu_under_pinned_config(tmp_path):
+    """--platform cpu is the flag form of the same escape hatch."""
+    fixture = tmp_path / "test.txt"
+    fixture.write_text("a b a\n")
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'nosuchplatform,cpu')\n"
+        "from mapreduce_tpu.cli import main\n"
+        f"raise SystemExit(main(['--platform', 'cpu', {str(fixture)!r}]))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root",
+             "PYTHONPATH": str(REPO)},  # no JAX_PLATFORMS at all
+    )
+    assert r.returncode == 0, r.stderr
+    assert "Total Count:3" in r.stdout
+
+
+def test_watchdog_gate_reads_config_not_env(monkeypatch):
+    """The probe gate keys off the EFFECTIVE platform (jax.config), not the
+    raw env var: here the env var claims an accelerator but the config (what
+    JAX will actually dial) says cpu, so no probe must run."""
+    from mapreduce_tpu import cli
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    # conftest forced jax.config.jax_platforms to "cpu" for the whole suite;
+    # _apply_platform must report that config value, not the env var.
+    assert cli._apply_platform("auto") == "cpu"
