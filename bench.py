@@ -213,7 +213,12 @@ def main() -> int:
     if os.environ.get("BENCH_PROBE", "1") != "0":
         from mapreduce_tpu.runtime.probe import wait_for_device
 
-        budget = float(os.environ.get("BENCH_RETRY_BUDGET_S", "240"))
+        # BENCH_PROBE_BUDGET_S (alias: BENCH_RETRY_BUDGET_S) sizes the probe
+        # budget to the caller's — a driver with a 20-min budget can spend
+        # most of it catching a relay-recovery window instead of giving up
+        # at the 4-min default.
+        budget = float(os.environ.get("BENCH_PROBE_BUDGET_S")
+                       or os.environ.get("BENCH_RETRY_BUDGET_S", "240"))
         probe_t = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "45"))
         platform, attempts = wait_for_device(
             budget, probe_t, log=lambda m: _log(m, wall0))

@@ -91,7 +91,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "regex-lite byte classes — '.' (any byte but "
                         "newline), '[a-z0-9]', '[^...]', '\\\\x' escapes; "
                         "fixed length, no repetition/alternation")
-    p.add_argument("--sample", type=int, default=0, metavar="K",
+    p.add_argument("--sample", type=int, default=None, metavar="K",
                    help="report a uniform random sample of K token "
                         "occurrences instead of counts (mergeable bottom-k "
                         "sketch; composes with --stream; deterministic for "
@@ -313,7 +313,11 @@ def main(argv: list[str] | None = None) -> int:
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
         parser.error("--count-sketch/--estimate and --distinct-sketch are "
                      "mutually exclusive per run")
-    if args.grep is not None or args.sample:
+    if args.sample is not None and args.sample < 1:
+        # A distinct None default so an explicit --sample 0 errors instead
+        # of silently falling through to word-count mode.
+        parser.error(f"--sample must be >= 1, got {args.sample}")
+    if args.grep is not None or args.sample is not None:
         # Honest failure beats a flag silently ignored: grep/sample modes
         # do not count words, so word-count-only flags are errors.
         mode = "--grep" if args.grep is not None else "--sample"
@@ -324,10 +328,8 @@ def main(argv: list[str] | None = None) -> int:
                               ("--estimate", bool(args.estimate))):
             if present:
                 parser.error(f"{flag} is not supported with {mode}")
-    if args.grep is not None and args.sample:
+    if args.grep is not None and args.sample is not None:
         parser.error("--grep and --sample are mutually exclusive")
-    if args.sample < 0:
-        parser.error(f"--sample must be >= 1, got {args.sample}")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -402,7 +404,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.grep is not None:
         return _grep_main(args, paths, data, config, input_bytes)
-    if args.sample:
+    if args.sample is not None:
         return _sample_main(args, paths, data, config, input_bytes)
 
     t0 = time.perf_counter()

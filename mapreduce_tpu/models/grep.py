@@ -384,11 +384,22 @@ class GrepJob(MapReduceJob):
         return state._replace(line_carry=jnp.zeros_like(state.line_carry))
 
     def merge(self, a: GrepState, b: GrepState) -> GrepState:
+        """Merge two accumulated states (collective finish, or cross-host).
+
+        Within one ``run_job`` invocation every device's carry is identical
+        (the block transfer comes from the gathered summaries), so summing
+        lines is exact and either operand's carry is fine.  Merging states
+        from INDEPENDENT per-host ``byte_range`` runs is different: host
+        ranges are aligned to token separators (any whitespace), so a
+        logical line straddling two ranges appears in both and ``lines``
+        degrades to an upper bound (off by at most hosts-1).  For exact
+        cross-host lines, align the ranges to newlines
+        (``align_range_to_separator(..., separators=b"\\n")``) so no line
+        straddles a seam.  ``matches`` is exact either way.
+        """
         m_lo, m_hi = _add64(a.matches_lo, a.matches_hi,
                             b.matches_lo, b.matches_hi)
         l_lo, l_hi = _add64(a.lines_lo, a.lines_hi, b.lines_lo, b.lines_hi)
-        # Every device's carry is identical (the block transfer comes from
-        # the gathered summaries), so either operand's is fine.
         return GrepState(m_lo, m_hi, l_lo, l_hi, a.line_carry)
 
     def identity(self) -> str:

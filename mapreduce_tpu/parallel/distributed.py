@@ -133,7 +133,8 @@ def host_byte_range(file_size: int, process_index: Optional[int] = None,
 
 
 def align_range_to_separator(path: str, lo: int, hi: int,
-                             max_token_bytes: int = 1 << 16) -> tuple[int, int]:
+                             max_token_bytes: int = 1 << 16,
+                             separators: bytes | None = None) -> tuple[int, int]:
     """Snap a byte range so both ends sit just after a separator byte.
 
     Every host applies the same deterministic rule to its own ``lo`` and
@@ -142,10 +143,15 @@ def align_range_to_separator(path: str, lo: int, hi: int,
     by it.  ``max_token_bytes`` bounds the scan past the cut (a pathological
     separator-free file falls back to the raw offset, force-splitting the
     token exactly like the in-range reader does).
+
+    ``separators`` overrides the boundary byte class (default: the token
+    separator set).  Cross-host grep wants ``separators=b"\\n"`` so no
+    logical LINE straddles a range seam — per-host line counts then merge
+    exactly (:meth:`...models.grep.GrepJob.merge`).
     """
     from mapreduce_tpu import constants
 
-    sep = bytes(constants.SEPARATOR_BYTES)
+    sep = bytes(constants.SEPARATOR_BYTES) if separators is None else separators
     size = os.path.getsize(path)
 
     def snap(off: int) -> int:

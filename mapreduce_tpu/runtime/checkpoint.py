@@ -83,7 +83,8 @@ _FORMAT = 2
 
 
 def save(path: str, state: Any, step: int, offset: int,
-         bases: np.ndarray, fingerprint: dict | None = None) -> None:
+         bases: np.ndarray, fingerprint: dict | None = None,
+         file_index: int | None = None) -> None:
     """Atomically persist a run snapshot.
 
     Args:
@@ -93,12 +94,19 @@ def save(path: str, state: Any, step: int, offset: int,
       offset: file offset ingest should resume from.
       bases: int64[steps_done, D] absolute row base offsets so far.
       fingerprint: run identity from :func:`run_fingerprint`.
+      file_index: corpus-member index of the last batch folded into
+        ``state`` (multi-file runs).  Jobs with cross-row sequential state
+        (grep's line carry) reset it at file boundaries; a resumed run needs
+        this to know the snapshot sits at a boundary — without it the
+        boundary hook silently never fires after resume and the carry leaks
+        across the seam (round-2 advisor finding).
     """
     leaves = jax.tree.leaves(state)
     payload = {f"__leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     payload["__step"] = np.int64(step)
     payload["__offset"] = np.int64(offset)
     payload["__bases"] = np.asarray(bases, dtype=np.int64)
+    payload["__file_index"] = np.int64(-1 if file_index is None else file_index)
     payload["__meta"] = np.frombuffer(
         json.dumps({**(fingerprint or {}), "format": _FORMAT}).encode(),
         dtype=np.uint8)
@@ -117,8 +125,12 @@ def save(path: str, state: Any, step: int, offset: int,
 
 def load(path: str, template: Any = None,
          expect_fingerprint: dict | None = None
-         ) -> tuple[Any, int, int, np.ndarray]:
-    """Load a snapshot; returns (state, step, offset, bases).
+         ) -> tuple[Any, int, int, np.ndarray, int | None]:
+    """Load a snapshot; returns (state, step, offset, bases, file_index).
+
+    ``file_index`` is the corpus-member index the snapshot's last folded
+    batch came from (None for snapshots predating the field, or single-file
+    runs saved before any batch).
 
     ``template`` is a pytree with the running job's state structure (e.g.
     ``Engine.init_states()`` output); the snapshot's leaves are validated
@@ -161,9 +173,12 @@ def load(path: str, template: Any = None,
                         f"this run has {key}={want!r}; delete the checkpoint "
                         f"or rerun with the original configuration")
         n_saved = sum(1 for k in z.files if k.startswith("__leaf_"))
+        fi = int(z["__file_index"]) if "__file_index" in z.files else -1
+        file_index = None if fi < 0 else fi
         if template is None:
             leaves = [z[f"__leaf_{i}"] for i in range(n_saved)]
-            return leaves, int(z["__step"]), int(z["__offset"]), z["__bases"]
+            return (leaves, int(z["__step"]), int(z["__offset"]), z["__bases"],
+                    file_index)
         if n_saved != len(t_leaves):
             raise CheckpointMismatch(
                 f"checkpoint {path} holds a different state structure "
@@ -183,7 +198,8 @@ def load(path: str, template: Any = None,
                     f"configuration")
             leaves.append(got)
         state = jax.tree.unflatten(treedef, leaves)
-        return state, int(z["__step"]), int(z["__offset"]), z["__bases"]
+        return (state, int(z["__step"]), int(z["__offset"]), z["__bases"],
+                file_index)
 
 
 def exists(path: str) -> bool:

@@ -104,13 +104,15 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         # sketch precision, or device count surfaces as CheckpointMismatch
         # (shapes are ground truth).
         template = jax.eval_shape(engine.init_states)
-        state_np, start_step, start_offset, bases_arr = ckpt_mod.load(
-            checkpoint_path, template=template, expect_fingerprint=fingerprint)
+        state_np, start_step, start_offset, bases_arr, resumed_file = \
+            ckpt_mod.load(checkpoint_path, template=template,
+                          expect_fingerprint=fingerprint)
         state = jax.device_put(state_np, engine._sharded)
         bases_list = list(bases_arr)
         log_event(logger, "resumed from checkpoint", step=start_step, offset=start_offset)
     else:
         state = engine.init_states()
+        resumed_file = None
 
     bytes_done = int(start_offset)
     step_index = start_step
@@ -159,6 +161,15 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         for attempt in range(retry + 1):
             try:
                 state = dispatch(state, group)
+                if retry > 0:
+                    # Device failures surface asynchronously at the next
+                    # blocking fetch — which without this sync would be the
+                    # NEXT group's snapshot, outside this try: the failure
+                    # would skip retry entirely and be blamed on the wrong
+                    # step.  Blocking here attributes it to the dispatch
+                    # that caused it.  (retry=0 keeps the async pipeline:
+                    # there is nothing to attribute a failure to.)
+                    jax.block_until_ready(state)
                 break
             except Exception:
                 if attempt >= retry:
@@ -188,9 +199,14 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             # snapshot format holds ANY job state pytree (tables, sketched
             # states, grep scalars alike).
             state_host = jax.tree.map(np.asarray, state)
+            # file_index makes the snapshot boundary-aware: resuming a
+            # checkpoint that ends a corpus member must still fire the
+            # job's on_input_boundary hook on the next member's first batch
+            # (the carry reset happens AFTER this save in the stream loop).
             ckpt_mod.save(checkpoint_path, state_host, step_index,
                           bytes_done, np.stack(bases_list),
-                          fingerprint=fingerprint)
+                          fingerprint=fingerprint,
+                          file_index=group[-1].file_index)
             log_event(logger, "checkpoint", step=step_index, path=checkpoint_path)
         return state
 
@@ -200,7 +216,11 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     # like the other hooks; transitions are rare (once per corpus member),
     # so the early superstep flush they force costs nothing measurable.
     boundary_hook = getattr(job, "on_input_boundary", None)
-    last_file: Optional[int] = None
+    # Resume restores which corpus member the snapshot's last batch came
+    # from, so a snapshot saved at a file seam still triggers the boundary
+    # hook on the next file's first batch (advisor round 2: last_file=None
+    # after resume silently skipped the reset and leaked grep's line carry).
+    last_file: Optional[int] = resumed_file
     # Prefetch: host-side chunking of step N+1 overlaps device compute of
     # step N (the double-buffering of SURVEY §7 step 4).
     for batch in reader_mod.prefetch(

@@ -218,3 +218,18 @@ def test_watchdog_gate_reads_config_not_env(monkeypatch):
     # conftest forced jax.config.jax_platforms to "cpu" for the whole suite;
     # _apply_platform must report that config value, not the env var.
     assert cli._apply_platform("auto") == "cpu"
+
+
+def test_sample_zero_is_an_error(tmp_path):
+    """--sample 0 must error, not silently fall through to word-count mode
+    (advisor round 2: the old 0-default made an explicit 0 indistinguishable
+    from the flag being absent)."""
+    f = tmp_path / "in.txt"
+    f.write_text("a b a\n")
+    r = _run([str(f), "--sample", "0"])
+    assert r.returncode == 2
+    assert "--sample must be >= 1" in r.stderr
+    # And a valid sample still works.
+    r2 = _run([str(f), "--sample", "2", "--format", "json"])
+    assert r2.returncode == 0, r2.stderr
+    assert len(json.loads(r2.stdout)["sample"]) == 2

@@ -68,7 +68,7 @@ def test_checkpoint_resume_same_result(tmp_path, rng):
     # from the last checkpoint and re-running.
     executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck, checkpoint_every=1)
     assert ckpt.exists(ck)
-    state, step, offset, bases = ckpt.load(ck)
+    state, step, offset, bases, _ = ckpt.load(ck)
     assert step > 1 and 0 < offset <= len(corpus)
 
     resumed = executor.count_file(path, CFG, mesh=mesh, checkpoint_path=ck,
@@ -142,7 +142,7 @@ def test_checkpoint_roundtrip(tmp_path):
     stacked = jax.tree.map(lambda x: np.broadcast_to(np.asarray(x)[None], (4,) + x.shape), t)
     p = str(tmp_path / "ck.npz")
     ckpt.save(p, stacked, step=3, offset=12345, bases=np.zeros((3, 4), np.int64))
-    s2, step, offset, bases = ckpt.load(p, template=stacked)
+    s2, step, offset, bases, _ = ckpt.load(p, template=stacked)
     assert step == 3 and offset == 12345 and bases.shape == (3, 4)
     for f in t._fields:
         np.testing.assert_array_equal(np.asarray(getattr(stacked, f)),

@@ -391,3 +391,47 @@ def test_class_pattern_cli(tmp_path, capsys):
     # --grep-syntax without --grep is an honest error.
     with pytest.raises(SystemExit):
         cli.main([str(path), "--grep-syntax", "class"])
+
+
+def test_grep_resume_across_file_seam_keeps_boundary_reset(tmp_path, monkeypatch):
+    """Advisor round 2 (medium): the flush that ends a file checkpoints
+    BEFORE the boundary hook resets the line carry, so the snapshot holds a
+    set carry and sits exactly at the seam.  A resumed run must still fire
+    on_input_boundary on the next file's first batch — without the persisted
+    file index it silently never did, and the resumed count diverged from
+    the uninterrupted one (lines=1 vs lines=2)."""
+    from mapreduce_tpu.parallel import mapreduce as mr
+    from mapreduce_tpu.parallel.mesh import data_mesh
+
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    a.write_bytes(b"x MATCH")   # unterminated matching line: carry=1 at EOF
+    b.write_bytes(b"MATCH y\n")
+    cfg = Config(chunk_bytes=128)
+    paths = [str(a), str(b)]
+    mesh = data_mesh(2)
+
+    full = grep.grep_file(paths, b"MATCH", config=cfg, mesh=mesh)
+    assert (full.matches, full.lines) == (2, 2)
+
+    # checkpoint_every=1 puts a snapshot right after file A's only step;
+    # the injected crash hits file B's first step, so the resumed run
+    # starts exactly at the seam with the pre-reset carry.
+    ck = str(tmp_path / "ck.npz")
+    original = mr.Engine.step
+    fired = []
+
+    def crash_at_seam(self, state, chunks, step_index):
+        if step_index == 1 and not fired:
+            fired.append(step_index)
+            raise RuntimeError("injected crash at file seam")
+        return original(self, state, chunks, step_index)
+
+    monkeypatch.setattr(mr.Engine, "step", crash_at_seam)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        grep.grep_file(paths, b"MATCH", config=cfg, mesh=mesh,
+                       checkpoint_path=ck, checkpoint_every=1)
+    assert fired, "injection never fired; test is vacuous"
+
+    resumed = grep.grep_file(paths, b"MATCH", config=cfg, mesh=mesh,
+                             checkpoint_path=ck, checkpoint_every=1)
+    assert (resumed.matches, resumed.lines) == (full.matches, full.lines)
