@@ -274,8 +274,11 @@ def main() -> int:
 
     # Capacities sized to the corpus: 50K-word Zipf vocab fits comfortably in
     # a 256K-slot table and 64K distinct-per-chunk batch extraction.
+    # BENCH_SORT_MODE switches the aggregation sort strategy (sort3/segmin,
+    # bit-identical results) so live windows can A/B the sort floor.
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
-                 batch_unique_capacity=1 << 16)
+                 batch_unique_capacity=1 << 16,
+                 sort_mode=os.environ.get("BENCH_SORT_MODE", "sort3"))
     mesh = data_mesh()
     n_dev = mesh.devices.size
     engine = Engine(WordCountJob(cfg), mesh)

@@ -99,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
+    p.add_argument("--sort-mode", choices=("sort3", "segmin"), default="sort3",
+                   help="aggregation sort strategy on the pallas fast path "
+                        "(bit-identical results; 'segmin' trades the third "
+                        "sort key for a segmented min scan — see "
+                        "tools/sortbench.py)")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -360,7 +365,8 @@ def main(argv: list[str] | None = None) -> int:
         config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
                         backend=args.backend, superstep=args.superstep,
                         pallas_max_token=args.max_token_bytes,
-                        sketch_flush_every=args.sketch_flush_every)
+                        sketch_flush_every=args.sketch_flush_every,
+                        sort_mode=args.sort_mode)
     except ValueError as e:
         parser.error(str(e))
 

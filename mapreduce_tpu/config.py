@@ -57,6 +57,15 @@ class Config:
     # at the price of K * batch_uniques rows of extra device state.  1 =
     # scatter every step (the round-1 behavior).
     sketch_flush_every: int = 1
+    # Aggregation sort strategy for the packed fast path (the single-chip
+    # floor: the 3-array sort over the pair-compacted stream is 25-85 ms of
+    # the ~102 ms chunk budget, BENCHMARKS.md).  'sort3' (default) carries
+    # `packed` as a third sort key so each key segment's head row is its
+    # first occurrence; 'segmin' sorts with only the two key lanes in the
+    # comparator (packed rides as payload) and recovers first occurrence
+    # with a segmented running-min instead.  Bit-identical results;
+    # tools/sortbench.py measures both on the real chip.
+    sort_mode: str = "sort3"
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
@@ -68,6 +77,8 @@ class Config:
                 f"sketch_flush_every must be >= 1, got {self.sketch_flush_every}")
         if self.backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.sort_mode not in ("sort3", "segmin"):
+            raise ValueError(f"unknown sort_mode {self.sort_mode!r}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
         if self.backend != "xla" and not 1 <= self.pallas_max_token <= 63:

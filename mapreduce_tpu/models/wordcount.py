@@ -93,7 +93,7 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
         t = table_ops.from_stream(
             stream, capacity, pos_hi=pos_hi,
             max_token_bytes=config.pallas_max_token,
-            max_pos=int(chunk.shape[0]))
+            max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode)
         # ``overlong`` counts occurrences.  For dropped_count (occurrences)
         # that is exact; for dropped_uniques it is the only available upper
         # bound — overlong tokens leave the kernel unhashed, so distinct

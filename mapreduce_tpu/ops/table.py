@@ -185,7 +185,7 @@ def _build(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int,
 
 def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
                      total: jax.Array, capacity: int, pos_hi: jax.Array | int,
-                     len_bits: int = 6) -> CountTable:
+                     len_bits: int = 6, sort_mode: str = "sort3") -> CountTable:
     """Aggregate pre-packed single-occurrence rows (the sort-lean path).
 
     ``packed`` = ``pos << len_bits | length`` per live row (all-ones for
@@ -202,6 +202,15 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
          rank-range differences, and per-key fields as capacity-sized gathers
          at the segment heads.
 
+    ``sort_mode='segmin'`` replaces step 1's three-key comparator with a
+    two-key sort (``packed`` rides as payload, arbitrary order within a
+    segment) and recovers each key's first occurrence as a segmented
+    running-min of ``packed`` instead — min(pos << bits | len) is the
+    smallest pos since equal keys share a length.  The stream sort is the
+    single-chip floor (25-85 ms of the ~102 ms chunk budget, BENCHMARKS.md),
+    so shaving a comparator lane matters if the scan is cheaper than the
+    third key; both modes are bit-identical, tools/sortbench.py decides.
+
     Matches :func:`_build` output bit-for-bit under its preconditions (every
     live row has count 1, one shared pos_hi).
     """
@@ -210,16 +219,37 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     n = key_hi.shape[0]
     len_mask = jnp.uint32((1 << len_bits) - 1)
 
-    key_hi, key_lo, packed = jax.lax.sort(
-        (key_hi, key_lo, packed), num_keys=3)
-    _, rank = _segment_boundaries(key_hi, key_lo)
+    if sort_mode == "segmin":
+        key_hi, key_lo, packed = jax.lax.sort(
+            (key_hi, key_lo, packed), num_keys=2)
+        boundary, rank = _segment_boundaries(key_hi, key_lo)
+
+        def _min_combine(x, y):
+            # y is the later element; a boundary row restarts its segment.
+            xb, xv = x
+            yb, yv = y
+            return xb | yb, jnp.where(yb, yv, jnp.minimum(xv, yv))
+
+        _, run_min = jax.lax.associative_scan(_min_combine, (boundary, packed))
+    else:
+        key_hi, key_lo, packed = jax.lax.sort(
+            (key_hi, key_lo, packed), num_keys=3)
+        _, rank = _segment_boundaries(key_hi, key_lo)
+        run_min = None
 
     # Segment j occupies rows [head[j], head[j+1]) in sorted order.
     head = _segment_heads(rank, capacity)
     fi = jnp.minimum(head[:capacity], n - 1)
     count_u = (head[1:] - head[:capacity]).astype(jnp.uint32)
 
-    key_hi_u, key_lo_u, packed_u = key_hi[fi], key_lo[fi], packed[fi]
+    key_hi_u, key_lo_u = key_hi[fi], key_lo[fi]
+    if run_min is None:
+        packed_u = packed[fi]  # sorted third key: head row IS min packed
+    else:
+        # The running min lands on each segment's LAST row (inclusive scan
+        # restarting at boundaries).
+        tail = jnp.minimum(jnp.maximum(head[1:], 1) - 1, n - 1)
+        packed_u = run_min[tail]
     occupied = (head[:capacity] < n) & ((key_hi_u != sent) | (key_lo_u != sent)) \
         & (count_u > 0)
 
@@ -240,7 +270,8 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
 
 
 def _from_stream_packed(stream: TokenStream, capacity: int,
-                        pos_hi: jax.Array | int) -> CountTable:
+                        pos_hi: jax.Array | int,
+                        sort_mode: str = "sort3") -> CountTable:
     """Packed fast path for token streams: see :func:`from_packed_rows`."""
     # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
     # feed their raw plane straight into the sort — repacking from
@@ -255,12 +286,14 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     if total is None:
         total = jnp.sum(stream.count)
     return from_packed_rows(stream.key_hi, stream.key_lo, packed, total,
-                            capacity, pos_hi, len_bits=6)
+                            capacity, pos_hi, len_bits=6,
+                            sort_mode=sort_mode)
 
 
 def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
                 max_token_bytes: int | None = None,
-                max_pos: int | None = None) -> CountTable:
+                max_pos: int | None = None,
+                sort_mode: str = "sort3") -> CountTable:
     """Aggregate a per-byte :class:`TokenStream` into a fresh table.
 
     ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
@@ -270,11 +303,12 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
     stream's length and pos fields.  When both fit a packed uint32
     (len <= 63, pos < 2**26 — true for the pallas backend's bounded-W
     streams over chunks <= 64 MB), a sort-lean fast path runs instead of
-    the generic build; results are identical.
+    the generic build; results are identical.  ``sort_mode`` picks that
+    path's sort strategy (:func:`from_packed_rows`).
     """
     if (max_token_bytes is not None and max_token_bytes <= 63
             and max_pos is not None and max_pos <= (1 << 26)):
-        return _from_stream_packed(stream, capacity, pos_hi)
+        return _from_stream_packed(stream, capacity, pos_hi, sort_mode)
     n = stream.key_hi.shape[0]
     ph = jnp.full((n,), jnp.asarray(pos_hi, dtype=jnp.uint32))
     ph = jnp.where(stream.count > 0, ph, jnp.uint32(constants.POS_INF))

@@ -217,3 +217,51 @@ def test_merge_associativity_under_spill(rng):
     for f in tbl.CountTable._fields:
         np.testing.assert_array_equal(np.asarray(getattr(ab_c, f)),
                                       np.asarray(getattr(a_bc, f)))
+
+
+def _random_packed_rows(rng, n, n_keys):
+    """Random single-occurrence rows: live prefix density ~50%, Zipf-ish key
+    duplication, sentinel dead rows, packed = pos << 6 | len."""
+    sent = np.uint32(constants.SENTINEL_KEY)
+    khi = np.full(n, sent, np.uint32)
+    klo = np.full(n, sent, np.uint32)
+    packed = np.full(n, 0xFFFFFFFF, np.uint32)
+    n_live = n // 2
+    live = np.sort(rng.choice(n, size=n_live, replace=False))
+    keys = rng.integers(0, n_keys, size=n_live)
+    khi[live] = (keys * 2654435761 % (1 << 32)).astype(np.uint32)
+    klo[live] = (keys * 40503 + 17).astype(np.uint32)
+    # Distinct positions per row; equal keys share a length (as real tokens do).
+    lengths = (keys % 60 + 1).astype(np.uint32)
+    packed[live] = (np.arange(n_live, dtype=np.uint32) * 2 << 6) | lengths
+    # Shuffle live rows so positions are not sorted within a key.
+    perm = rng.permutation(n_live)
+    khi[live], klo[live], packed[live] = khi[live][perm], klo[live][perm], packed[live][perm]
+    return jnp.asarray(khi), jnp.asarray(klo), jnp.asarray(packed), n_live
+
+
+def test_segmin_sort_mode_bit_identical(rng):
+    """sort_mode='segmin' (2-key sort + segmented running-min) must equal
+    sort_mode='sort3' leaf-for-leaf, including first-occurrence positions,
+    spill accounting under capacity pressure, and sentinel handling."""
+    for n, n_keys, cap in ((1 << 12, 200, 256), (1 << 12, 200, 64),
+                           (1 << 10, 5, 16), (1 << 10, 1000, 1 << 11)):
+        khi, klo, packed, n_live = _random_packed_rows(rng, n, n_keys)
+        total = jnp.uint32(n_live)
+        a = tbl.from_packed_rows(khi, klo, packed, total, cap, pos_hi=3,
+                                 sort_mode="sort3")
+        b = tbl.from_packed_rows(khi, klo, packed, total, cap, pos_hi=3,
+                                 sort_mode="segmin")
+        for la, lb, name in zip(a, b, a._fields):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                          err_msg=f"{name} n={n} cap={cap}")
+
+
+def test_segmin_end_to_end_equals_sort3(small_corpus):
+    """The full pallas-path pipeline under sort_mode='segmin' produces the
+    identical result object (interpret mode on CPU)."""
+    base = dict(chunk_bytes=1 << 14, table_capacity=1 << 10, backend="pallas")
+    r3 = wordcount.count_words(small_corpus, Config(**base, sort_mode="sort3"))
+    rm = wordcount.count_words(small_corpus, Config(**base, sort_mode="segmin"))
+    assert r3.as_dict() == rm.as_dict()
+    assert r3.words == rm.words and r3.counts == rm.counts

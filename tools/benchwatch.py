@@ -11,9 +11,10 @@ window into numbers without a human in the loop: a bounded probe every
   2. ``bench.py`` natural 100MB (enwik8-sized English-text proxy row)
   3. ``tools/sortbench.py``     (sort-floor variant timings)
 
-appending each JSON/log line to --out (default tools/benchwatch.log), then
-exits 0 so a supervising session gets notified.  Exits 3 if the budget
-(--max-hours) runs out without a live window.
+appending each JSON/log line to --out (default /tmp/benchwatch.log — outside
+the repo tree so snapshot commits never sweep it in), then exits 0 so a
+supervising session gets notified.  Exits 3 if the budget (--max-hours) runs
+out without a live window.
 
 Probe children follow the never-kill rule (see runtime/probe.py): a hung
 probe is left to die on its own; each attempt spawns fresh.
@@ -46,9 +47,9 @@ def run_step(out_path: str, name: str, cmd: list[str], env: dict,
     A stalled step is abandoned (left to finish and release its claim on
     its own) and reported as failed."""
     log(out_path, f"running {name}: {' '.join(cmd)}")
-    stdout_f = open(out_path + f".{name}.out", "w")
-    proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=stdout_f,
-                            stderr=subprocess.STDOUT, text=True)
+    with open(out_path + f".{name}.out", "w") as stdout_f:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=stdout_f,
+                                stderr=subprocess.STDOUT, text=True)
     try:
         proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -85,16 +86,20 @@ def main() -> int:
                           "running bench suite")
             env = {**os.environ, "BENCH_PROBE": "1",
                    "BENCH_PROBE_BUDGET_S": "120"}
-            ok1 = run_step(args.out, "bench-zipf",
-                           [sys.executable, "bench.py"], env, 1800)
-            ok2 = run_step(args.out, "bench-natural",
-                           [sys.executable, "bench.py"],
-                           {**env, "BENCH_CORPUS": "natural", "BENCH_MB": "100"},
-                           1800)
-            ok3 = run_step(args.out, "sortbench",
-                           [sys.executable, "tools/sortbench.py"], env, 1800)
-            log(args.out, f"suite done: zipf={ok1} natural={ok2} sort={ok3}")
-            return 0 if (ok1 or ok2 or ok3) else 2
+            steps = [
+                ("bench-zipf", [sys.executable, "bench.py"], env),
+                ("sortbench", [sys.executable, "tools/sortbench.py"], env),
+                ("bench-zipf-segmin", [sys.executable, "bench.py"],
+                 {**env, "BENCH_SORT_MODE": "segmin"}),
+                ("bench-natural-100mb", [sys.executable, "bench.py"],
+                 {**env, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
+                ("bench-zipf-chunk64", [sys.executable, "bench.py"],
+                 {**env, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
+            ]
+            results = {name: run_step(args.out, name, cmd, e, 1800)
+                       for name, cmd, e in steps}
+            log(args.out, f"suite done: {results}")
+            return 0 if any(results.values()) else 2
         if platform == "cpu":
             log(args.out, f"attempt {attempt}: probe resolved cpu (no TPU "
                           "platform configured?) — not a live TPU window")

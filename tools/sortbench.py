@@ -19,11 +19,14 @@ or DCE the work, best-of-k.
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # 16.8M default: one 32 MB chunk's pair-compacted stream.  SORTBENCH_LOG2
 # shrinks it (e.g. 20 for CPU sanity runs).
@@ -110,6 +113,27 @@ def main():
         return m
 
     bench("2-key sort + segmented scan-min of packed", seg_min, (khi, klo, packed))
+
+    # Full aggregation (sort + rank reduce + table build) under each
+    # sort_mode: this is the number that decides config.sort_mode — and the
+    # denominator for "sort share of the chunk budget" (VERDICT r2 #1).
+    from mapreduce_tpu.ops import table as table_ops
+
+    cap = 1 << 18
+    n_tok_u = jnp.uint32(n_tok)
+    for mode in ("sort3", "segmin"):
+        bench(f"from_packed_rows[{mode}] full aggregation",
+              lambda a, b, c, m=mode: table_ops.from_packed_rows(
+                  a, b, c, n_tok_u, cap, 0, sort_mode=m),
+              (khi, klo, packed))
+
+    # The per-step pairwise table merge (the other half of a streaming step).
+    t_a = table_ops.from_packed_rows(khi, klo, packed, n_tok_u, cap, 0)
+    t_b = table_ops.from_packed_rows(klo, khi, packed, n_tok_u, cap, 1)
+    bench("pairwise table merge (cap 256K)",
+          lambda a_hi, ta=t_a, tb=t_b: table_ops.merge(
+              ta._replace(key_hi=a_hi), tb, capacity=cap),
+          (t_a.key_hi,))
 
 
 if __name__ == "__main__":
