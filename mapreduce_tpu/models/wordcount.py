@@ -238,13 +238,15 @@ class NGramCountJob(WordCountJob):
     the exact single-buffer semantics on a one-device mesh.
 
     Backends: the XLA path pairs tokens with carry-forward scans over the
-    flat per-byte stream; the pallas backend sorts the fused kernel's packed
-    stream by position (one sort key recovers global token order, seam
-    emissions included, so grams straddle the kernel's 128-lane seams
-    exactly) and pairs rows elementwise — falling back to the XLA scan, per
-    chunk, only when a chunk contains overlong tokens the kernel suppressed
-    (:mod:`mapreduce_tpu.ops.ngram`).  Both backends produce bit-identical
-    tables.
+    flat per-byte stream and counts any token length exactly; the pallas
+    backend sorts the fused kernel's packed stream by position (one sort
+    key recovers global token order, seam emissions included, so grams
+    straddle the kernel's 128-lane seams exactly) and pairs rows
+    elementwise.  Grams containing a token longer than the kernel window W
+    self-invalidate at in-stream poison rows and land in ``dropped_*``
+    accounting — the same >W contract as the pallas wordcount path
+    (:mod:`mapreduce_tpu.ops.ngram`).  On overlong-free data the backends
+    produce bit-identical tables.
     """
 
     def __init__(self, n: int, config: Config = DEFAULT_CONFIG,

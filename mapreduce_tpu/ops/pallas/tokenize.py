@@ -193,18 +193,30 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
 
     # packed = start << 6 | length: the sort payload, built where the data
     # already is.  start = global byte offset of the token's first byte.
+    # Overlong ends emit a POISON row instead: position of the run's last
+    # byte with zero length bits (impossible for a real token).  Consumers
+    # needing global token order (n-grams, sampling) keep poison rows in
+    # their position sort, where they break row-adjacency across the
+    # suppressed token — so grams spanning it self-invalidate instead of
+    # pairing phantom neighbors (this replaces a whole-chunk lax.cond
+    # fallback to the XLA scan, which embedded a pathologically-slow-to-
+    # compile program in every n-gram step; VERDICT r2 #4).
     lane = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 1)
     start = lane * data_rows + m + 1 - ln_e.astype(jnp.int32)
+    last_byte = (lane * data_rows + m).astype(jnp.uint32)
     packed = jnp.where(emit, (start.astype(jnp.uint32) << 6) | ln_e,
-                       jnp.uint32(0xFFFFFFFF))
+                       jnp.where(overlong_here, last_byte << 6,
+                                 jnp.uint32(0xFFFFFFFF)))
 
-    # Pairwise fold: adjacent rows never both emit, so each (2r, 2r+1) pair
-    # holds at most one token — select it via a sublane-group reshape.
+    # Pairwise fold: adjacent rows are never both token ends (a real or
+    # overlong end at m needs byte m+1 to be a separator), so each
+    # (2r, 2r+1) pair holds at most one emission or poison — select it via
+    # a sublane-group reshape.
     def fold(a, take_even):
         g = a.reshape(tb // 2, 2, LANES)
         return jnp.where(take_even, g[:, 0, :], g[:, 1, :])
 
-    even_has = ln_e.reshape(tb // 2, 2, LANES)[:, 0, :] > 0
+    even_has = (emit | overlong_here).reshape(tb // 2, 2, LANES)[:, 0, :]
     khi_ref[:] = fold(khi, even_has)
     klo_ref[:] = fold(klo, even_has)
     packed_ref[:] = fold(packed, even_has)
@@ -278,17 +290,24 @@ def _seam_pass(data: jax.Array, seg_len: int, w: int,
     # Overlong tokens counted here, exactly once each: truncated-at-left
     # fragments whose true end is visible (their lookback crossed the seam, so
     # the kernel deferred them), and complete-but-longer-than-W seam tokens.
-    overlong = jnp.sum((is_tok & touches
-                        & ((wstart == 0) & (wpos_end <= 2 * w)
-                           | complete & (length > w))).astype(jnp.uint32))
+    is_overlong = is_tok & touches & ((wstart == 0) & (wpos_end <= 2 * w)
+                                      | complete & (length > w))
+    overlong = jnp.sum(is_overlong.astype(jnp.uint32))
 
     sent = jnp.uint32(constants.SENTINEL_KEY)
     global_start = (starts[:, None] - (w + 1) + wstart).astype(jnp.int32)
+    # Poison rows mirror the kernel's: the overlong run's LAST byte position,
+    # zero length, sentinel key, count 0.  They ride the `pos` plane (count=0
+    # rows are inert everywhere else) so concat_streams can pack them for
+    # position-ordered consumers.
+    global_end = (starts[:, None] - (w + 1) + wpos_end).astype(jnp.int32)
+    pos = jnp.where(emit, global_start, jnp.where(is_overlong, global_end,
+                                                  jnp.int32(-1)))
     stream = TokenStream(
         key_hi=jnp.where(emit, streams.key_hi, sent).reshape(-1),
         key_lo=jnp.where(emit, streams.key_lo, sent).reshape(-1),
         count=jnp.where(emit, jnp.uint32(1), jnp.uint32(0)).reshape(-1),
-        pos=jnp.where(emit, global_start.astype(jnp.uint32)
+        pos=jnp.where(pos >= 0, pos.astype(jnp.uint32)
                       + jnp.asarray(base_offset, jnp.uint32),
                       jnp.uint32(constants.POS_INF)).reshape(-1),
         length=jnp.where(emit, streams.length, jnp.uint32(0)).reshape(-1),
@@ -375,7 +394,11 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
     khi = khi.reshape(-1)
     klo = klo.reshape(-1)
     packed = packed.reshape(-1)
-    has_tok = packed != jnp.uint32(0xFFFFFFFF)
+    # Zero length bits mark overlong-end POISON rows (position-ordering
+    # markers, not tokens): excluded from the token view here, kept in the
+    # packed plane for position-ordered consumers.
+    has_tok = (packed != jnp.uint32(0xFFFFFFFF)) \
+        & ((packed & jnp.uint32(63)) != 0)
     ln = jnp.where(has_tok, packed & jnp.uint32(63), jnp.uint32(0))
     start = jnp.where(has_tok,
                       (packed >> 6) + jnp.asarray(base_offset, jnp.uint32),
@@ -403,7 +426,11 @@ def concat_streams(col: PackedTokenStream, seam: TokenStream) -> PackedTokenStre
     """
     sent = jnp.uint32(0xFFFFFFFF)
     seam_tok = seam.count > 0
-    seam_packed = jnp.where(seam_tok, (seam.pos << 6) | seam.length, sent)
+    # count=0 rows with a real pos are the seam pass's POISON rows (overlong
+    # ends): packed with zero length bits, like the kernel's own.
+    seam_poison = ~seam_tok & (seam.pos != jnp.uint32(constants.POS_INF))
+    seam_packed = jnp.where(seam_tok, (seam.pos << 6) | seam.length,
+                            jnp.where(seam_poison, seam.pos << 6, sent))
     cat = lambda a, b: jnp.concatenate([a, b])
     return PackedTokenStream(
         key_hi=cat(col.key_hi, seam.key_hi),

@@ -7,6 +7,7 @@ whitespace-split stream, keyed by the exact source span (separators between
 tokens included).
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -212,19 +213,70 @@ def test_pallas_gram_straddles_lane_seam():
     assert pal.dropped_count == 0
 
 
-def test_pallas_ngram_overlong_fallback(small_corpus):
-    """A chunk containing a token longer than the kernel window W falls back
-    to the XLA scan (per chunk): results must still equal the XLA backend's
-    exactly — suppressed tokens never pair their neighbors into phantom
-    grams."""
+def test_pallas_ngram_overlong_poison(small_corpus):
+    """A chunk containing a token longer than the kernel window W: poison
+    rows break the pairing chain at the suppressed token, so its neighbors
+    never pair into phantom grams; the grams it would have joined are
+    dropped and accounted (VERDICT r2 #4 — this replaced the whole-chunk
+    lax.cond XLA fallback that embedded a pathologically-slow-to-compile
+    branch in every n-gram program)."""
     data = small_corpus[:4000] + b" " + b"x" * 40 + b" " + small_corpus[4000:]
     pal = wordcount.count_ngrams(data, 2, PALLAS_CFG)
     xla = wordcount.count_ngrams(data, 2,
                                  Config(table_capacity=1 << 14, backend="xla"))
-    assert pal.as_dict() == xla.as_dict()
+    # total_count includes dropped grams: the closed-form total is shared.
     assert pal.total == xla.total
-    # The long token IS in the gram stream (XLA semantics after fallback).
-    assert any(b"x" * 40 in w for w in pal.words)
+    # The long token joins exactly 2 bigrams; both dropped, never phantom.
+    long_grams = {w for w in xla.words if b"x" * 40 in w}
+    assert len(long_grams) == 2
+    assert pal.dropped_count == sum(
+        xla.counts[xla.words.index(w)] for w in long_grams)
+    assert not any(b"x" * 40 in w for w in pal.words)
+    # Every other gram is identical, with identical counts — and no phantom
+    # gram (a span bridging the suppressed token) appears.
+    pal_counts = dict(zip(pal.words, pal.counts))
+    xla_counts = {w: c for w, c in zip(xla.words, xla.counts)
+                  if w not in long_grams}
+    assert pal_counts == xla_counts
+
+
+def test_pallas_ngram_overlong_adjacent_grams_trigram():
+    """Overlong tokens adjacent to real grams, n=3: the whole pairing window
+    crossing the poison row invalidates (not just the immediate neighbor
+    pair), and dense gram structure around the suppression stays exact."""
+    data = b"aa bb " + b"y" * 50 + b" cc dd ee " + b"z" * 40 + b" ff gg"
+    pal = wordcount.count_ngrams(data, 3, PALLAS_CFG)
+    xla = wordcount.count_ngrams(data, 3,
+                                 Config(table_capacity=1 << 14, backend="xla"))
+    assert pal.total == xla.total  # closed-form total incl. dropped
+    # Only trigrams fully inside a run of <=W tokens survive: "cc dd ee".
+    assert pal.words == [b"cc dd ee"]
+    # 9 tokens -> 7 trigrams total; 1 formed, 6 dropped (every window that
+    # touches y*50 or z*40).
+    assert pal.counts == [1]
+    assert pal.dropped_count == 6
+    # And the XLA backend counts all 7 exactly (any token length).
+    assert xla.total == 7 and xla.dropped_count == 0
+
+
+def test_pallas_ngram_program_has_no_cond_fallback():
+    """The n-gram program must be straight-line: no lax.cond (both branches
+    of a cond are always compiled, so an embedded XLA-scan fallback would
+    poison every program's compile time at production chunk sizes)."""
+    import jax
+
+    from mapreduce_tpu.ops import ngram as ngram_ops
+
+    def step(chunk):
+        return ngram_ops.ngram_table(chunk, 2, 1 << 10, 0, PALLAS_CFG)
+
+    jaxpr = str(jax.make_jaxpr(step)(
+        jnp.zeros((PALLAS_CFG.chunk_bytes,), jnp.uint8)))
+    # Exactly one cond exists: the kernel's own `pl.when(i == 0)` scratch
+    # init INSIDE the pallas_call.  The deleted fallback was a top-level
+    # two-branch cond whose branches each returned a whole CountTable; any
+    # second cond appearing here means a fallback crept back in.
+    assert jaxpr.count("cond[") == 1 and "pallas_call" in jaxpr
 
 
 def test_streamed_pallas_ngrams_match_xla_backend(tmp_path):

@@ -5,11 +5,15 @@ on the FIRST live window, then exit.
 The bench chip sits behind a shared relay that can wedge for hours (rounds 1
 and 2 both lost their perf record to it).  This tool turns a brief recovery
 window into numbers without a human in the loop: a bounded probe every
---interval-s; on the first success it immediately runs
+--interval-s; on the first success it immediately runs the suite (each step
+bounded by a 1800 s abandoned-not-killed deadline, per-step output in
+``<out>.<step>.out``):
 
-  1. ``bench.py``               (zipf headline -> updates BENCH_LAST_GOOD.json)
-  2. ``bench.py`` natural 100MB (enwik8-sized English-text proxy row)
-  3. ``tools/sortbench.py``     (sort-floor variant timings)
+  1. bench-zipf           bench.py headline (updates BENCH_LAST_GOOD.json)
+  2. sortbench            tools/sortbench.py sort-floor variant timings
+  3. bench-zipf-segmin    bench.py under BENCH_SORT_MODE=segmin
+  4. bench-natural-100mb  enwik8-sized English-text proxy row
+  5. bench-zipf-chunk64   64 MB chunks (sort cost is sublinear in rows)
 
 appending each JSON/log line to --out (default /tmp/benchwatch.log — outside
 the repo tree so snapshot commits never sweep it in), then exits 0 so a

@@ -22,11 +22,19 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    # Honor the documented CPU-sanity mode even when sitecustomize pinned a
+    # remote platform at interpreter startup (the env var alone is too late
+    # — same escape hatch as the CLI's --platform cpu).
+    from mapreduce_tpu.runtime.platform import force_cpu
+
+    force_cpu()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # 16.8M default: one 32 MB chunk's pair-compacted stream.  SORTBENCH_LOG2
 # shrinks it (e.g. 20 for CPU sanity runs).
