@@ -43,8 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ngram", type=int, default=1, metavar="N",
                    help="count n-token grams instead of single words "
                         "(reported entries are the exact source spans, e.g. "
-                        "'Hello World'; with --stream, grams never span "
-                        "chunk seams)")
+                        "'Hello World'; --stream counts grams exactly, "
+                        "including ones spanning chunk seams)")
     p.add_argument("--chunk-bytes", type=int, default=1 << 20)
     p.add_argument("--table-capacity", type=int, default=1 << 18)
     p.add_argument("--format", choices=("reference", "json", "tsv"), default="reference",

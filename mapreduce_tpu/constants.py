@@ -45,3 +45,10 @@ SENTINEL_KEY = np.uint32(0xFFFFFFFF)
 
 # uint32 "infinity" used for first-occurrence position tracking (min-reduced).
 POS_INF = np.uint32(0xFFFFFFFF)
+
+# Length sentinel for cross-chunk n-gram table entries: the gram's true byte
+# span ends in a LATER chunk whose row base the device cannot know, so the
+# host recovers the span by scanning n tokens forward from the entry's
+# absolute start offset (reader.scan_gram_length).  Real span lengths are
+# bounded by the corpus size; the all-ones value cannot collide.
+SEAM_GRAM_LENGTH = np.uint32(0xFFFFFFFF)

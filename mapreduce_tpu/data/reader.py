@@ -249,3 +249,70 @@ def read_words_at(path: str, spans: list[tuple[int, int]]) -> list[bytes]:
         return []
     mm = np.memmap(path, dtype=np.uint8, mode="r")
     return [bytes(mm[off: off + ln]) for off, ln in spans]
+
+
+def scan_gram_lengths(paths, offsets, n: int,
+                      cut_offsets=None) -> list[int]:
+    """Byte lengths of the n-entry grams starting at virtual corpus offsets.
+
+    Host-side recovery for cross-chunk gram entries (length =
+    ``SEAM_GRAM_LENGTH``): the device knows each gram's absolute start but
+    not its end (it lies in a later chunk whose row base only the host
+    tracks), so the host scans forward from the start — which must be an
+    entry start — to the end of the n-th stream entry.  Separator runs
+    between tokens are unbounded, so the read window doubles until the gram
+    completes (or the file ends: the remaining bytes are the span).  Grams
+    never cross file boundaries (the executor resets the seam carry there),
+    so each scan stays within the file containing its offset.
+
+    ``cut_offsets``: absolute chunk-row base offsets of the run.  The
+    reader force-splits a separator-free run longer than its alignment
+    window at a row cut, making BOTH halves stream entries — so a cut
+    inside a run is an entry end too, and the scan counts it to match the
+    device's entry stream (without this, a seam span over a force-split
+    run would swallow the whole run plus the following real token).
+
+    Batch API: one file-size pass + one memmap per touched file, however
+    many offsets (a full table of seam entries is recovered in one call).
+    """
+    single = isinstance(paths, (str, bytes, os.PathLike))
+    plist = [paths] if single else list(paths)
+    starts = np.cumsum([0] + [_file_size(p) for p in plist])
+    cuts = np.sort(np.asarray(cut_offsets, dtype=np.int64)) \
+        if cut_offsets is not None else np.empty(0, np.int64)
+    offs = np.asarray(list(offsets), dtype=np.int64)
+    file_idx = np.searchsorted(starts, offs, side="right") - 1
+    mms: dict[int, np.memmap] = {}
+    out: list[int] = []
+    for j, off in enumerate(offs):
+        k = int(file_idx[j])
+        if k not in mms:
+            mms[k] = np.memmap(plist[k], dtype=np.uint8, mode="r")
+        mm = mms[k]
+        base, local, size = int(starts[k]), int(off - starts[k]), mm.shape[0]
+        win = 4096
+        while True:
+            end = min(local + win, size)
+            buf = np.asarray(mm[local:end])
+            sep = _SEP_LUT[buf]
+            at_eof = end >= size
+            # Entry ends: non-separator followed by separator (or EOF)...
+            nxt = np.concatenate([sep[1:], np.array([True])]) if at_eof \
+                else sep[1:]
+            ends = ~sep[: len(nxt)] & nxt
+            # ...plus force-split ends: a chunk cut at absolute c ends the
+            # entry at byte c-1 when that byte is a non-separator (if the
+            # following byte is a separator this is already an end).
+            lo_v = base + local
+            ci = cuts[(cuts > lo_v) & (cuts <= lo_v + len(nxt))] - lo_v - 1
+            if len(ci):
+                ends[ci[~sep[ci]]] = True
+            epos = np.flatnonzero(ends)
+            if len(epos) >= n:
+                out.append(int(epos[n - 1]) + 1)
+                break
+            if at_eof:  # corpus ends mid-gram: remaining bytes are the span
+                out.append(int(len(buf)))
+                break
+            win *= 2
+    return out

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -35,8 +35,12 @@ class WordCountResult:
     words: list[bytes]  # reported words, by first occurrence
     counts: list[int]  # parallel to words
     total: int  # total tokens (includes any spilled/dropped ones; exact)
-    distinct: int  # distinct words: exact when dropped_uniques == 0, else an
-    #   upper bound (len(words) + dropped_uniques)
+    distinct: int  # distinct words: exact when dropped_uniques == 0; under
+    #   table spill, a KMV estimate read off the full table's largest kept
+    #   key (~1/sqrt(capacity) relative error — 0.2% at the default 256K;
+    #   see ops.table.kmv_distinct), far tighter than the summed per-chunk
+    #   bound it replaces.  Top-k finalized runs keep the upper bound (the
+    #   terminal reorder destroys the KMV property).
     dropped_uniques: int  # upper bound on distinct words spilled past table
     #   capacity or dropped as overlong; loose because cross-chunk merges sum
     #   per-chunk bounds and the pallas backend cannot hash (hence cannot
@@ -124,7 +128,19 @@ def count_table(data: bytes | np.ndarray, config: Config = DEFAULT_CONFIG) -> ta
     return _count_step(jax.device_put(padded), config.table_capacity, config)
 
 
-def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
+def _reported_distinct(tbl: table_ops.CountTable, n_words: int,
+                       dropped_uniques: int, estimate: bool) -> int:
+    """``distinct`` for a recovered result: exact when nothing spilled;
+    the table's free KMV estimate when it did (see WordCountResult)."""
+    if estimate and dropped_uniques > 0:
+        est = table_ops.kmv_distinct(tbl)
+        if est is not None:
+            return max(n_words, int(round(est)))
+    return n_words + dropped_uniques
+
+
+def recover_result(tbl: table_ops.CountTable, source: bytes,
+                   estimate_distinct: bool = True) -> WordCountResult:
     """Host-side string recovery from a single-buffer table (pos_hi == 0)."""
     count = np.asarray(tbl.count)
     valid = count > 0
@@ -138,7 +154,8 @@ def recover_result(tbl: table_ops.CountTable, source: bytes) -> WordCountResult:
         words=words,
         counts=[int(c) for c in cnt[order]],
         total=int(np.asarray(tbl.total_count())),
-        distinct=len(words) + dropped_uniques,
+        distinct=_reported_distinct(tbl, len(words), dropped_uniques,
+                                    estimate_distinct),
         dropped_uniques=dropped_uniques,
         dropped_count=int(np.asarray(tbl.dropped_count)),
     )
@@ -222,6 +239,24 @@ class TopKWordCountJob(WordCountJob):
         return f"wordcount-top{self.k}"
 
 
+class NGramState(NamedTuple):
+    """Streamed n-gram accumulator: running table + the last n-1 stream
+    entries seen (the seam carry; ``ops.ngram.GramCarry``)."""
+
+    table: table_ops.CountTable
+    carry: Any
+
+
+class NGramUpdate(NamedTuple):
+    """One streamed step's per-device contribution: the chunk's in-window
+    gram table, the step's gathered chunk summaries ([D]-leading leaves,
+    identical on every device), and this device's linear index."""
+
+    batch: table_ops.CountTable
+    summaries: Any
+    device_index: jax.Array
+
+
 class NGramCountJob(WordCountJob):
     """Count n-token grams (bigrams, trigrams, ...) instead of single words.
 
@@ -231,11 +266,14 @@ class NGramCountJob(WordCountJob):
     machinery, and each reported "word" is the exact source span of the gram
     (inter-token separators included, e.g. ``b"Hello World"``).
 
-    Semantics envelope: grams are counted within each chunk's contiguous byte
-    range; a gram whose tokens straddle a chunk seam is not formed, so a
-    streamed run undercounts by at most ``(n-1) * (chunks - 1)`` grams versus
-    a single-buffer run.  With multi-MB chunks this is negligible; tests pin
-    the exact single-buffer semantics on a one-device mesh.
+    Streamed runs are EXACT across chunk seams: each chunk's map also emits
+    its first/last n-1 stream entries, one small all_gather shares them
+    across the step, and ``combine`` composes the running carry in global
+    chunk order — forming every window that crosses a join exactly once, the
+    way grep threads its exact line carry (the round-2 "streamed runs
+    undercount by up to (n-1)*(chunks-1)" envelope is gone).  Cross-chunk
+    entries carry ``SEAM_GRAM_LENGTH`` and the host recovers their spans by
+    scanning forward from the absolute start offset.
 
     Backends: the XLA path pairs tokens with carry-forward scans over the
     flat per-byte stream and counts any token length exactly; the pallas
@@ -258,6 +296,8 @@ class NGramCountJob(WordCountJob):
         self.k = top_k
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
+        """Per-chunk gram table (in-chunk windows only; the streamed seam
+        machinery lives in :meth:`map_chunk_sharded` + :meth:`combine`)."""
         if self.config.resolved_backend() == "pallas":
             from mapreduce_tpu.ops import ngram as ngram_ops
 
@@ -266,8 +306,90 @@ class NGramCountJob(WordCountJob):
         stream = tok_ops.ngrams(tok_ops.tokenize(chunk), self.n)
         return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
 
+    # -- exact cross-chunk grams (streamed runs) ----------------------------
+
+    def init_state(self):
+        from mapreduce_tpu.ops import ngram as ngram_ops
+
+        if self.n == 1:
+            return table_ops.empty(self.capacity)
+        return NGramState(table=table_ops.empty(self.capacity),
+                          carry=ngram_ops.empty_carry(self.n))
+
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        """Streamed map: per-chunk table + this chunk's seam summary, with
+        one small all_gather so every device sees the step's D summaries.
+        The summaries are ~5*(n-1) words per chunk — noise next to the
+        chunk itself."""
+        if self.n == 1:
+            return self.map_chunk(chunk, chunk_id)
+        from mapreduce_tpu.ops import ngram as ngram_ops
+
+        if self.config.resolved_backend() == "pallas":
+            t, summ = ngram_ops.ngram_map_with_summary(
+                chunk, self.n, self.batch_capacity, chunk_id, self.config)
+        else:
+            stream = tok_ops.tokenize(chunk)
+            gs = tok_ops.ngrams(stream, self.n)
+            t = table_ops.from_stream(gs, self.batch_capacity, pos_hi=chunk_id)
+            summ = ngram_ops.summary_from_stream(stream, chunk_id, self.n)
+        gathered = jax.lax.all_gather(summ, axis_name=axis)  # leaves [D, n-1]
+        return NGramUpdate(batch=t, summaries=gathered,
+                           device_index=device_index)
+
+    def combine(self, state, update):
+        if self.n == 1:
+            return super().combine(state, update)
+        from mapreduce_tpu.ops import ngram as ngram_ops
+
+        d_count = update.summaries.first.kind.shape[0]
+        # Prefix carries in global chunk order: prefix[i] = everything before
+        # this step's chunk i (state.carry composed with summaries 0..i-1).
+        # A trace-time loop of D tiny elementwise folds; the final value is
+        # the next step's carry, identical on every device.
+        prefix = state.carry
+        prefixes = [prefix]
+        for i in range(d_count):
+            s_i = jax.tree.map(lambda x, i=i: x[i], update.summaries)
+            prefix = ngram_ops.compose_carry(prefix, s_i.last)
+            prefixes.append(prefix)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *prefixes[:d_count])
+        d = update.device_index.astype(jnp.int32)
+        my_prefix = jax.tree.map(lambda x: jnp.take(x, d, axis=0), stacked)
+        my_first = jax.tree.map(lambda x: jnp.take(x, d, axis=0),
+                                update.summaries.first)
+        seam_tbl = ngram_ops.seam_gram_table(my_prefix, my_first, self.n)
+        batch = table_ops.merge(update.batch, seam_tbl,
+                                capacity=update.batch.capacity)
+        table = table_ops.merge(state.table, batch, capacity=self.capacity)
+        return NGramState(table=table, carry=prefixes[-1])
+
+    def merge(self, a, b):
+        if self.n == 1:
+            return super().merge(a, b)
+        # Cross-device table reduction; carries are identical on every
+        # device after combine (computed from the same gathered summaries),
+        # so either operand's is fine.
+        return NGramState(
+            table=table_ops.merge(a.table, b.table, capacity=self.capacity),
+            carry=a.carry)
+
+    def on_input_boundary(self, state):
+        """Files are independent corpora: grams must not span a file seam.
+
+        Called on the engine's STACKED state (carry leaves [D, n-1]), so the
+        reset must preserve shapes (zeros_like, the GrepJob idiom) — a fresh
+        empty_carry would collapse the leading device axis and break the
+        next step's sharding.
+        """
+        if self.n == 1:
+            return state
+        return NGramState(table=state.table,
+                          carry=jax.tree.map(jnp.zeros_like, state.carry))
+
     def finalize(self, state):
-        return table_ops.top_k(state, self.k) if self.k else state
+        tbl = state.table if isinstance(state, NGramState) else state
+        return table_ops.top_k(tbl, self.k) if self.k else tbl
 
     def identity(self) -> str:
         # Resuming a bigram run's snapshot as a trigram run (same shapes!)
@@ -361,17 +483,40 @@ class _SketchComposedJob:
     def map_chunk(self, chunk, chunk_id) -> table_ops.CountTable:
         return self.base.map_chunk(chunk, chunk_id)
 
-    def combine(self, state, update: table_ops.CountTable):
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        """Forward the base job's axis-aware map (n-grams' exact seam
+        machinery) so sketch composition doesn't silently disable it."""
+        fn = getattr(self.base, "map_chunk_sharded", None)
+        if fn is not None:
+            return fn(chunk, chunk_id, axis, device_index)
+        return self.base.map_chunk(chunk, chunk_id)
+
+    def on_input_boundary(self, state):
+        """Forward the base job's file-boundary hook (n-gram carry reset)."""
+        hook = getattr(self.base, "on_input_boundary", None)
+        if hook is None:
+            return state
+        return state._replace(table=hook(state.table))
+
+    @staticmethod
+    def _batch_of(update) -> table_ops.CountTable:
+        """The plain CountTable inside an update (n-gram updates bundle it
+        with seam summaries).  Sketch envelope: cross-chunk seam grams
+        (< n per step) miss the sketch, like spilled batch rows do."""
+        return update if isinstance(update, table_ops.CountTable) else update.batch
+
+    def combine(self, state, update):
+        batch = self._batch_of(update)
         if self.flush_every == 1:
             return self.state_cls(self.base.combine(state[0], update),
-                                  self._update(state[1], update))
+                                  self._update(state[1], batch))
         table = self.base.combine(state.table, update)
-        b = update.key_hi.shape[0]
+        b = batch.key_hi.shape[0]
         off = (state.cursor % jnp.uint32(self.flush_every)) * jnp.uint32(b)
         off = off.astype(jnp.int32)
-        pend_hi = jax.lax.dynamic_update_slice(state.pend_hi, update.key_hi, (off,))
-        pend_lo = jax.lax.dynamic_update_slice(state.pend_lo, update.key_lo, (off,))
-        pend_cnt = jax.lax.dynamic_update_slice(state.pend_cnt, update.count, (off,))
+        pend_hi = jax.lax.dynamic_update_slice(state.pend_hi, batch.key_hi, (off,))
+        pend_lo = jax.lax.dynamic_update_slice(state.pend_lo, batch.key_lo, (off,))
+        pend_cnt = jax.lax.dynamic_update_slice(state.pend_cnt, batch.count, (off,))
         cursor = state.cursor + jnp.uint32(1)
 
         def flush(_):

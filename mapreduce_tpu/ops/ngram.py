@@ -44,6 +44,8 @@ The reference has no n-gram capability (its map UDF emits single words only,
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -135,13 +137,24 @@ def ngram_table(chunk: jax.Array, n: int, capacity: int,
     ``all_tokens`` including overlong ones, so whatever the pairing did not
     form was dropped by suppression.
     """
+    t, _ = ngram_map_with_summary(chunk, n, capacity, pos_hi, config)
+    return t
+
+
+def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
+                           pos_hi: jax.Array | int, config):
+    """(per-chunk table, :class:`ChunkSummary`) — the streamed exact-seam
+    map's device side, sharing one kernel run + one position sort between
+    in-chunk gram formation and the seam summary."""
     from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
     col, seam, overlong = pallas_tok.tokenize_split(
         chunk, max_token_bytes=config.pallas_max_token)
     stream = pallas_tok.concat_streams(col, seam)
-    gs = grams_from_sorted(*position_sorted(stream), n)
+    key_hi, key_lo, packed = position_sorted(stream)
+    gs = grams_from_sorted(key_hi, key_lo, packed, n)
     t = table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+    # Live sorted rows = real tokens + one poison row per overlong end.
     all_tokens = stream.total + overlong
     nm1 = jnp.uint32(n - 1)
     full_total = jnp.where(all_tokens > nm1, all_tokens - nm1, jnp.uint32(0))
@@ -149,5 +162,196 @@ def ngram_table(chunk: jax.Array, n: int, capacity: int,
     # ``missing`` occurrences are exact; distinct missing grams are unknowable
     # on device (overlong tokens leave the kernel unhashed), so uniques get
     # the same upper-bound treatment as the wordcount family's overlong.
-    return t._replace(dropped_uniques=t.dropped_uniques + missing,
-                      dropped_count=t.dropped_count + missing)
+    t = t._replace(dropped_uniques=t.dropped_uniques + missing,
+                   dropped_count=t.dropped_count + missing)
+    summ = summary_from_packed(key_hi, key_lo, packed, all_tokens, pos_hi, n)
+    return t, summ
+
+
+# --- Exact cross-chunk grams: carry summaries + seam windows -----------------
+#
+# A streamed run splits the corpus into chunks; grams whose tokens straddle a
+# chunk seam have no single chunk to form in.  Mirroring grep's exact line
+# carry (models/grep.py): each chunk's map emits a tiny summary — its first
+# and last up-to-(n-1) position-ordered stream ENTRIES (tokens and poison
+# markers alike) — the devices share summaries with one small all_gather per
+# step, and the job's combine composes them in global chunk order, forming
+# every window that crosses a join exactly once (at the join where its final
+# token's chunk lands).  The carry composition is the classic sliding-window
+# monoid: `compose_carry` keeps the last n-1 entries of a concatenation, so
+# chunks with fewer than n-1 tokens (even zero) chain correctly and windows
+# spanning 3+ chunks complete at the right join.
+
+KIND_EMPTY = 0  # unoccupied slot
+KIND_TOKEN = 1  # real token entry
+KIND_POISON = 2  # suppressed >W token: occupies its slot, poisons windows
+
+
+class GramCarry(NamedTuple):
+    """Up-to-(n-1) consecutive stream entries.  All fields uint32[n-1].
+
+    Used both LEFT-aligned (a chunk's first entries, slot 0 oldest) and
+    RIGHT-aligned (the running carry / a chunk's last entries, slot n-2
+    newest); empty slots carry kind 0 and zeroed fields.
+    """
+
+    key_hi: jax.Array
+    key_lo: jax.Array
+    chunk_id: jax.Array
+    pos: jax.Array
+    kind: jax.Array
+
+
+class ChunkSummary(NamedTuple):
+    """One chunk's seam-relevant view: first entries (left-aligned) + last
+    entries (right-aligned).  A tiny fixed-shape pytree — the per-step
+    all_gather moves ~5*(n-1) words per chunk."""
+
+    first: GramCarry
+    last: GramCarry
+
+
+def empty_carry(n: int) -> GramCarry:
+    z = jnp.zeros((n - 1,), jnp.uint32)
+    return GramCarry(z, jnp.zeros_like(z), jnp.zeros_like(z),
+                     jnp.zeros_like(z), jnp.zeros_like(z))
+
+
+def chunk_summary(key_hi: jax.Array, key_lo: jax.Array, pos: jax.Array,
+                  poison: jax.Array, n_entries: jax.Array, chunk_id: jax.Array,
+                  n: int) -> ChunkSummary:
+    """Summary of a position-sorted stream (live rows first).
+
+    Inputs are position-ordered arrays (live entries occupying the first
+    ``n_entries`` rows — real tokens + poison markers; data-dependent).
+    Poison rows are kept: an overlong token at a chunk edge must poison
+    cross-chunk windows exactly like in-chunk ones.  The pallas caller
+    derives ``pos``/``poison`` from the kernel's packed plane; the XLA
+    caller has no poison (any token length hashes exactly).
+    """
+    m = n - 1
+    cap = key_hi.shape[0]
+    cid = jnp.broadcast_to(jnp.asarray(chunk_id, jnp.uint32), (m,))
+    ne = n_entries.astype(jnp.int32)
+
+    def mk(idx, valid):
+        idx_c = jnp.clip(idx, 0, cap - 1)
+        kind = jnp.where(valid,
+                         jnp.where(poison[idx_c], jnp.uint32(KIND_POISON),
+                                   jnp.uint32(KIND_TOKEN)),
+                         jnp.uint32(KIND_EMPTY))
+        live = kind != KIND_EMPTY
+        z = jnp.uint32(0)
+        return GramCarry(
+            key_hi=jnp.where(live, key_hi[idx_c], z),
+            key_lo=jnp.where(live, key_lo[idx_c], z),
+            chunk_id=jnp.where(live, cid, z),
+            pos=jnp.where(live, pos[idx_c], z),
+            kind=kind,
+        )
+
+    idx_f = jnp.arange(m, dtype=jnp.int32)
+    first = mk(idx_f, idx_f < ne)
+    idx_l = ne - m + jnp.arange(m, dtype=jnp.int32)
+    last = mk(idx_l, idx_l >= 0)
+    return ChunkSummary(first=first, last=last)
+
+
+def summary_from_packed(key_hi: jax.Array, key_lo: jax.Array,
+                        packed: jax.Array, n_entries: jax.Array,
+                        chunk_id: jax.Array, n: int) -> ChunkSummary:
+    """Pallas-path summary: position-sorted packed plane in, summary out."""
+    return chunk_summary(key_hi, key_lo, packed >> 6,
+                         (packed & jnp.uint32(63)) == 0,
+                         n_entries, chunk_id, n)
+
+
+def summary_from_stream(stream: TokenStream, chunk_id: jax.Array,
+                        n: int) -> ChunkSummary:
+    """XLA-path summary: one single-key position sort of the per-byte
+    stream (non-tokens carry POS_INF and sink), no poison (the XLA
+    tokenizer hashes any token length exactly)."""
+    pos_key = jnp.where(stream.count > 0, stream.pos,
+                        jnp.uint32(constants.POS_INF))
+    pos_s, khi_s, klo_s = jax.lax.sort(
+        (pos_key, stream.key_hi, stream.key_lo), num_keys=1)
+    n_live = jnp.sum(stream.count)
+    return chunk_summary(khi_s, klo_s, pos_s, jnp.zeros_like(pos_s, jnp.bool_),
+                         n_live, chunk_id, n)
+
+
+def compose_carry(carry: GramCarry, last: GramCarry) -> GramCarry:
+    """Append a chunk's last-entries to the running carry, keeping the most
+    recent n-1 entries (right-aligned).  The sliding-window monoid's fold:
+    ``sv`` newer entries shift the old carry left by ``sv``."""
+    m = carry.kind.shape[0]
+    sv = jnp.sum((last.kind != KIND_EMPTY).astype(jnp.int32))
+    k = jnp.arange(m, dtype=jnp.int32)
+    take_new = k >= (m - sv)
+    idx_old = jnp.clip(k + sv, 0, m - 1)
+    pick = lambda old, new: jnp.where(take_new, new, old[idx_old])
+    return GramCarry(*(pick(o, s) for o, s in zip(carry, last)))
+
+
+def seam_gram_rows(prefix: GramCarry, first: GramCarry, n: int):
+    """Windows crossing the join between ``prefix`` (right-aligned: all
+    entries before this chunk) and this chunk's ``first`` entries.
+
+    Returns ``(key_hi, key_lo, chunk_id, pos, count, dropped)`` — n-1 rows,
+    row j-1 the window taking j entries from the left.  A window EXISTS when
+    all n slots are occupied (otherwise it completes at a later join, or the
+    corpus simply ends); an existing window is counted when every entry is a
+    real token, and dropped (suppressed >W token inside) otherwise.
+    ``dropped`` is the scalar count of such windows.  Hash composition is
+    bit-identical to :func:`grams_from_sorted`.
+    """
+    m = n - 1
+    sentinel = jnp.uint32(constants.SENTINEL_KEY)
+    one = jnp.uint32(1)
+    rows_hi, rows_lo, rows_cid, rows_pos, rows_cnt = [], [], [], [], []
+    dropped = jnp.uint32(0)
+    for j in range(1, n):
+        ents = [(prefix, m - j + t) for t in range(j)] \
+            + [(first, t) for t in range(n - j)]
+        src0, i0 = ents[0]
+        g_hi = src0.key_hi[i0]
+        g_lo = src0.key_lo[i0]
+        occupied = src0.kind[i0] != KIND_EMPTY
+        all_tok = src0.kind[i0] == KIND_TOKEN
+        for src, i in ents[1:]:
+            occupied = occupied & (src.kind[i] != KIND_EMPTY)
+            all_tok = all_tok & (src.kind[i] == KIND_TOKEN)
+            g_hi = tok_ops._fmix32(
+                g_hi * jnp.uint32(constants.HASH_BASE_1) ^ src.key_hi[i])
+            g_lo = tok_ops._fmix32(
+                g_lo * jnp.uint32(constants.HASH_BASE_2) ^ src.key_lo[i])
+            at_sent = (g_hi == sentinel) & (g_lo == sentinel)
+            g_lo = jnp.where(at_sent, g_lo - one, g_lo)
+        counted = occupied & all_tok
+        dropped = dropped + (occupied & ~all_tok).astype(jnp.uint32)
+        rows_hi.append(jnp.where(counted, g_hi, sentinel))
+        rows_lo.append(jnp.where(counted, g_lo, sentinel))
+        rows_cid.append(jnp.where(counted, prefix.chunk_id[m - j],
+                                  jnp.uint32(constants.POS_INF)))
+        rows_pos.append(jnp.where(counted, prefix.pos[m - j],
+                                  jnp.uint32(constants.POS_INF)))
+        rows_cnt.append(counted.astype(jnp.uint32))
+    stack = lambda xs: jnp.stack(xs)
+    return (stack(rows_hi), stack(rows_lo), stack(rows_cid), stack(rows_pos),
+            stack(rows_cnt), dropped)
+
+
+def seam_gram_table(prefix: GramCarry, first: GramCarry,
+                    n: int) -> table_ops.CountTable:
+    """The join's cross-window contribution as a tiny mergeable table.
+
+    Entries carry ``SEAM_GRAM_LENGTH`` so host recovery knows to scan the
+    span forward (its end lies in a later chunk whose row base the device
+    cannot know).  Dropped (poisoned) windows land in ``dropped_*``.
+    """
+    k_hi, k_lo, cid, pos, cnt, dropped = seam_gram_rows(prefix, first, n)
+    length = jnp.where(cnt > 0, jnp.uint32(constants.SEAM_GRAM_LENGTH),
+                       jnp.uint32(0))
+    return table_ops._build(k_hi, k_lo, cid, pos, cnt, length,
+                            capacity=max(n - 1, 2),
+                            carry_du=dropped, carry_dc=dropped)

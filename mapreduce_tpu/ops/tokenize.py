@@ -215,8 +215,9 @@ def ngrams(stream: TokenStream, n: int) -> TokenStream:
     consecutive tokens and carries the byte span from the first token's first
     byte through the last token's last byte — so the host recovers the exact
     source text (inter-word separators included) the same way it recovers
-    single words.  Grams never span chunk rows: each chunk's first n-1 tokens
-    start no gram, matching the per-chunk envelope documented by
+    single words.  This per-buffer op forms only IN-BUFFER grams (the first
+    n-1 tokens start no gram); streamed runs form the cross-chunk ones
+    exactly via the seam-carry machinery of
     :class:`mapreduce_tpu.models.wordcount.NGramCountJob`.
 
     The reference has no n-gram capability (its map UDF emits single words

@@ -23,13 +23,15 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from mapreduce_tpu import constants
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
 from mapreduce_tpu.data import reader as reader_mod
 from mapreduce_tpu.models.wordcount import (WordCountJob, TopKWordCountJob,
                                             NGramCountJob,
                                             SketchedState, SketchedWordCountJob,
                                             FreqSketchedState, FreqSketchedWordCountJob,
-                                            WordCountResult, apply_top_k)
+                                            WordCountResult, apply_top_k,
+                                            _reported_distinct)
 from mapreduce_tpu.ops import table as table_ops
 from mapreduce_tpu.parallel.mapreduce import Engine, MapReduceJob
 from mapreduce_tpu.parallel.mesh import data_mesh
@@ -272,12 +274,17 @@ def absolute_offsets(chunk_id: np.ndarray, pos: np.ndarray,
 
 
 def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
-                      n_devices: int) -> WordCountResult:
+                      n_devices: int, ngram: int = 1,
+                      estimate_distinct: bool = True) -> WordCountResult:
     """Host-side string recovery for a streamed run.
 
     ``pos_hi`` encodes chunk_id = step * n_devices + device; its absolute file
     base is ``bases[step, device]``.  Entries are reported in file order
     (first occurrence), the reference's insertion order (main.cu:212-215).
+
+    Entries whose length is ``SEAM_GRAM_LENGTH`` are cross-chunk grams: the
+    device knew the start but not the end (it lies in a later chunk), so the
+    span length is recovered here by scanning ``ngram`` tokens forward.
     """
     count = np.asarray(tbl.count)
     valid = count > 0
@@ -286,6 +293,12 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
     length = np.asarray(tbl.length)[valid].astype(np.int64)
     cnt = count[valid]
     absolute = absolute_offsets(chunk_id, pos, bases, n_devices)
+    seam = np.flatnonzero(length == int(constants.SEAM_GRAM_LENGTH))
+    if len(seam):
+        # Row bases mark force-split entry ends (the reader cuts separator-
+        # free runs there); one batch call maps each touched file once.
+        length[seam] = reader_mod.scan_gram_lengths(
+            path, absolute[seam], ngram, cut_offsets=bases.ravel())
     order = np.argsort(absolute, kind="stable")
     spans = [(int(absolute[i]), int(length[i])) for i in order]
     words = reader_mod.read_words_at_multi(path, spans)
@@ -294,7 +307,8 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
         words=words,
         counts=[int(c) for c in cnt[order]],
         total=int(np.asarray(tbl.total_count())),
-        distinct=len(words) + dropped_uniques,
+        distinct=_reported_distinct(tbl, len(words), dropped_uniques,
+                                    estimate_distinct),
         dropped_uniques=dropped_uniques,
         dropped_count=int(np.asarray(tbl.dropped_count)),
     )
@@ -317,8 +331,10 @@ def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
     sketches are mutually exclusive per run (their states checkpoint
     differently); pick the one matching the question being asked.
 
-    ``ngram > 1`` counts n-token grams instead of single words (per-chunk
-    gram semantics; see :class:`...models.wordcount.NGramCountJob`).
+    ``ngram > 1`` counts n-token grams instead of single words — exactly,
+    including grams spanning chunk seams (the seam-carry machinery of
+    :class:`...models.wordcount.NGramCountJob`); streamed results match
+    single-buffer runs bit-for-bit.
     """
     if distinct_sketch and count_sketch:
         raise ValueError("distinct_sketch and count_sketch are mutually "
@@ -339,7 +355,10 @@ def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
         value, registers = value.table, value.registers
     elif isinstance(value, FreqSketchedState):
         value, cms = value.table, np.asarray(value.cms)
-    result = recover_from_file(value, path, rr.bases, n_dev)
+    # Top-k finalize reorders the table on device, destroying the KMV
+    # property kmv_distinct needs; those runs keep the upper bound.
+    result = recover_from_file(value, path, rr.bases, n_dev, ngram=ngram,
+                               estimate_distinct=not top_k)
     if registers is not None:
         from mapreduce_tpu.ops import sketch as sketch_ops
 
