@@ -275,10 +275,12 @@ def main() -> int:
     # Capacities sized to the corpus: 50K-word Zipf vocab fits comfortably in
     # a 256K-slot table and 64K distinct-per-chunk batch extraction.
     # BENCH_SORT_MODE switches the aggregation sort strategy (sort3/segmin,
-    # bit-identical results) so live windows can A/B the sort floor.
+    # bit-identical results) and BENCH_MERGE_EVERY the table-merge cadence,
+    # so live windows can A/B the sort floor and the merge amortization.
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
-                 sort_mode=os.environ.get("BENCH_SORT_MODE", "sort3"))
+                 sort_mode=os.environ.get("BENCH_SORT_MODE", "sort3"),
+                 merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")))
     mesh = data_mesh()
     n_dev = mesh.devices.size
     engine = Engine(WordCountJob(cfg), mesh)

@@ -99,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=("auto", "xla", "pallas"), default="auto",
                    help="map-phase implementation (auto = pallas fused kernel "
                         "on TPU, xla scan elsewhere)")
+    p.add_argument("--merge-every", type=int, default=1, metavar="K",
+                   help="fold per-chunk batch tables into the running table "
+                        "once every K steps (one K-way reduce replaces K "
+                        "pairwise merges; word-count family only; kept "
+                        "counts identical)")
     p.add_argument("--sort-mode", choices=("sort3", "segmin"), default="sort3",
                    help="aggregation sort strategy on the pallas fast path "
                         "(bit-identical results; 'segmin' trades the third "
@@ -335,6 +340,11 @@ def main(argv: list[str] | None = None) -> int:
                 parser.error(f"{flag} is not supported with {mode}")
     if args.grep is not None and args.sample is not None:
         parser.error("--grep and --sample are mutually exclusive")
+    if args.ngram > 1 and args.merge_every > 1:
+        # Mirror NGramCountJob's refusal as a clean usage error instead of a
+        # mid-run traceback (the n-gram combine is pairwise by design).
+        parser.error("--merge-every applies to word-count runs only "
+                     "(not --ngram)")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -366,7 +376,8 @@ def main(argv: list[str] | None = None) -> int:
                         backend=args.backend, superstep=args.superstep,
                         pallas_max_token=args.max_token_bytes,
                         sketch_flush_every=args.sketch_flush_every,
-                        sort_mode=args.sort_mode)
+                        sort_mode=args.sort_mode,
+                        merge_every=args.merge_every)
     except ValueError as e:
         parser.error(str(e))
 

@@ -57,6 +57,18 @@ class Config:
     # at the price of K * batch_uniques rows of extra device state.  1 =
     # scatter every step (the round-1 behavior).
     sketch_flush_every: int = 1
+    # Fold per-chunk batch tables into the running table once every K steps
+    # instead of every step: batches stage into a pending buffer (cheap
+    # dynamic_update_slice) and ONE K-way sort+segment-reduce replaces K
+    # pairwise merges — 2*K sorts of (capacity + batch) rows become one
+    # sort of (capacity + K*batch), a ~2x cut of the merge share of the
+    # chunk budget at K >= 4 (sorts cost ~3 ms/M rows/array, BENCHMARKS.md).
+    # Kept keys and their counts, dropped_count, and totals are identical
+    # to K=1; only the dropped_uniques upper bound can differ under spill
+    # (a key respilled in several steps is counted once per flush, not once
+    # per step — a TIGHTER bound).  Costs K * batch_uniques * 6 words of
+    # device state.  1 = merge every step.
+    merge_every: int = 1
     # Aggregation sort strategy for the packed fast path (the single-chip
     # floor: the 3-array sort over the pair-compacted stream is 25-85 ms of
     # the ~102 ms chunk budget, BENCHMARKS.md).  'sort3' (default) carries
@@ -79,6 +91,9 @@ class Config:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.sort_mode not in ("sort3", "segmin"):
             raise ValueError(f"unknown sort_mode {self.sort_mode!r}")
+        if self.merge_every < 1:
+            raise ValueError(
+                f"merge_every must be >= 1, got {self.merge_every}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
         if self.backend != "xla" and not 1 <= self.pallas_max_token <= 63:

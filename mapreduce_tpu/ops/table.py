@@ -383,6 +383,31 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     )
 
 
+def merge_batched(table: CountTable, pend_key_hi, pend_key_lo, pend_count,
+                  pend_pos_hi, pend_pos_lo, pend_length,
+                  capacity: int) -> CountTable:
+    """Fold K staged batch tables + the running table in ONE sort + segment
+    reduce (``Config.merge_every``): 2*K pairwise-merge sorts of
+    (capacity + batch) rows become one 4-key sort of (capacity + K*batch).
+
+    The pending arrays hold up to K batch tables' rows (flushed slots carry
+    sentinel keys / zero counts, which the reduce ignores by construction).
+    Kept keys, their counts, first-occurrence positions, ``dropped_count``
+    and totals are identical to the pairwise fold — the kept set is the
+    smallest-``capacity`` distinct keys of the union either way;
+    ``dropped_uniques`` can only be TIGHTER (a respilled key counts once
+    per flush, not once per step).
+    """
+    cat = lambda a, b: jnp.concatenate([a, b])
+    return _build(cat(table.key_hi, pend_key_hi),
+                  cat(table.key_lo, pend_key_lo),
+                  cat(table.pos_hi, pend_pos_hi),
+                  cat(table.pos_lo, pend_pos_lo),
+                  cat(table.count, pend_count),
+                  cat(table.length, pend_length),
+                  capacity, table.dropped_uniques, table.dropped_count)
+
+
 def update(table: CountTable, stream: TokenStream, batch_capacity: int,
            pos_hi: jax.Array | int = 0) -> CountTable:
     """Fold one chunk's tokens into the running table (one streaming step)."""

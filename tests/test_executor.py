@@ -378,3 +378,78 @@ def test_mid_superstep_checkpoint_granularity(tmp_path, rng, monkeypatch):
         f"resume replayed from step {min(dispatched)}, not {crash_at['step']}"
     assert result.total == oracle.total_count(corpus)
     assert dict(zip(result.words, result.counts)) == oracle.word_counts(corpus)
+
+
+def test_merge_every_batched_equals_pairwise(tmp_path, rng):
+    """merge_every=K folds K staged batch tables in one reduce: results must
+    equal the K=1 pairwise fold — words, counts, totals, order — including
+    an end-of-stream flush of a partial buffer (chunk count not divisible
+    by K) and a device-side top-k finalize."""
+    corpus = make_corpus(rng, n_words=4000, vocab=200)
+    path = _write(tmp_path, corpus)
+    base = dict(chunk_bytes=512, table_capacity=1 << 12)
+    r1 = executor.count_file(path, Config(**base), mesh=data_mesh(2))
+    rk = executor.count_file(path, Config(**base, merge_every=3),
+                             mesh=data_mesh(2))
+    assert rk.words == r1.words and rk.counts == r1.counts
+    assert rk.total == r1.total and rk.distinct == r1.distinct
+    assert rk.dropped_count == r1.dropped_count
+
+    t1 = executor.count_file(path, Config(**base), mesh=data_mesh(2), top_k=7)
+    tk = executor.count_file(path, Config(**base, merge_every=4),
+                             mesh=data_mesh(2), top_k=7)
+    assert tk.as_dict() == t1.as_dict()
+
+
+def test_merge_every_under_capacity_pressure(tmp_path):
+    """Under table spill the kept keys/counts and dropped_count stay
+    identical; the dropped_uniques bound can only TIGHTEN (a respilled key
+    counts once per flush, not once per step)."""
+    words = [f"z{i:04d}" for i in range(3000)]
+    corpus = (" ".join(words) + " " + " ".join(words)).encode()
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    base = dict(chunk_bytes=512, table_capacity=256)
+    r1 = executor.count_file(str(path), Config(**base), mesh=data_mesh(2))
+    rk = executor.count_file(str(path), Config(**base, merge_every=4),
+                             mesh=data_mesh(2))
+    assert rk.words == r1.words and rk.counts == r1.counts
+    assert rk.total == r1.total
+    assert rk.dropped_count == r1.dropped_count
+    assert rk.dropped_uniques <= r1.dropped_uniques
+
+
+def test_merge_every_checkpoint_resume(tmp_path, rng):
+    """The buffered state (pending arrays + cursor) snapshots and resumes
+    exactly like any other state pytree."""
+    corpus = make_corpus(rng, n_words=3000, vocab=100)
+    path = _write(tmp_path, corpus)
+    cfg = Config(chunk_bytes=512, table_capacity=1 << 12, merge_every=3)
+    mesh = data_mesh(2)
+    full = executor.count_file(path, cfg, mesh=mesh)
+    ck = str(tmp_path / "ck.npz")
+    from mapreduce_tpu.parallel import mapreduce as mr
+
+    original = mr.Engine.step
+    fired = []
+
+    def crash_mid(self, state, chunks, step_index):
+        if step_index == 4 and not fired:
+            fired.append(1)
+            raise RuntimeError("injected crash")
+        return original(self, state, chunks, step_index)
+
+    import pytest as _pytest
+
+    try:
+        mr.Engine.step = crash_mid
+        with _pytest.raises(RuntimeError, match="injected"):
+            executor.count_file(path, cfg, mesh=mesh, checkpoint_path=ck,
+                                checkpoint_every=2)
+    finally:
+        mr.Engine.step = original
+    assert fired, "injection never fired; test is vacuous"
+    resumed = executor.count_file(path, cfg, mesh=mesh, checkpoint_path=ck,
+                                  checkpoint_every=2)
+    assert resumed.as_dict() == full.as_dict()
+    assert resumed.total == full.total
