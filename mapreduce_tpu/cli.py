@@ -335,7 +335,8 @@ def main(argv: list[str] | None = None) -> int:
                               ("--top-k", bool(args.top_k)),
                               ("--distinct-sketch", args.distinct_sketch),
                               ("--count-sketch", args.count_sketch),
-                              ("--estimate", bool(args.estimate))):
+                              ("--estimate", bool(args.estimate)),
+                              ("--merge-every", args.merge_every != 1)):
             if present:
                 parser.error(f"{flag} is not supported with {mode}")
     if args.grep is not None and args.sample is not None:
@@ -345,6 +346,10 @@ def main(argv: list[str] | None = None) -> int:
         # mid-run traceback (the n-gram combine is pairwise by design).
         parser.error("--merge-every applies to word-count runs only "
                      "(not --ngram)")
+    if args.merge_every != 1 and not args.stream:
+        # Honest failure beats a knob silently ignored: the single-buffer
+        # path has no per-step merges to batch.
+        parser.error("--merge-every requires --stream")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on

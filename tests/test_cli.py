@@ -233,3 +233,26 @@ def test_sample_zero_is_an_error(tmp_path):
     r2 = _run([str(f), "--sample", "2", "--format", "json"])
     assert r2.returncode == 0, r2.stderr
     assert len(json.loads(r2.stdout)["sample"]) == 2
+
+
+def test_merge_every_flag_validation(tmp_path):
+    """--merge-every must error where it would be a silent no-op: without
+    --stream, with --grep/--sample, and with --ngram (pairwise combine)."""
+    f = tmp_path / "in.txt"
+    f.write_text("a b a\n")
+    for args, msg in (
+        ([str(f), "--merge-every", "4"], "requires --stream"),
+        ([str(f), "--stream", "--merge-every", "4", "--grep", "a"],
+         "not supported"),
+        ([str(f), "--stream", "--merge-every", "4", "--sample", "1"],
+         "not supported"),
+        ([str(f), "--stream", "--merge-every", "4", "--ngram", "2"],
+         "word-count runs only"),
+    ):
+        r = _run(args)
+        assert r.returncode == 2, args
+        assert msg in r.stderr, args
+    # And the valid form still runs.
+    r = _run([str(f), "--stream", "--merge-every", "2", "--format", "json"])
+    assert r.returncode == 0, r.stderr
+    assert '"total": 3' in r.stdout

@@ -465,3 +465,24 @@ def test_seam_span_over_force_split_run(tmp_path):
     assert lengths == [5000]
     # Without the cut the two entries are run + "next": span reaches "next".
     assert reader.scan_gram_lengths(str(path), [0], 2) == [5000 + 5]
+
+
+def test_streamed_ngrams_superstep_exact(tmp_path):
+    """Superstep (lax.scan) dispatch: each scan iteration is one step —
+    its own summary gather + carry composition — so K-chunk supersteps
+    keep streamed n-grams bit-exact."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+    from tests.conftest import make_corpus
+
+    corpus = make_corpus(np.random.default_rng(84), n_words=2500, vocab=120)
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=1024, table_capacity=1 << 14, backend="xla",
+                 superstep=3)
+    result = count_file(str(path), config=cfg, mesh=data_mesh(2), ngram=2)
+    single = wordcount.count_ngrams(corpus, 2, Config(table_capacity=1 << 14,
+                                                      backend="xla"))
+    assert result.total == single.total
+    assert result.as_dict() == single.as_dict()
+    assert result.words == single.words
