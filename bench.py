@@ -317,7 +317,11 @@ def main() -> int:
         # Warm-up: pays the XLA compiles (one for the (k, repeats) program,
         # one for finish -- finish does not donate, so the state stays valid).
         state = engine.step_many(state, staged, 0, repeats=repeats)
-        np.asarray(state.dropped_count)
+        # Generic ONE-ELEMENT host fetch: the state may be a bare CountTable
+        # or (with BENCH_MERGE_EVERY > 1) a buffered pytree around one.  A
+        # fetch, not jax.block_until_ready — that is not a real barrier
+        # under remote-device tunnels (BENCHMARKS.md "Measurement rules").
+        np.asarray(jax.tree.leaves(state)[0].ravel()[:1])
         _log("warm-up dispatch done (compile paid)", wall0)
         np.asarray(engine.finish(state).dropped_count)
         _log("warm finish done", wall0)

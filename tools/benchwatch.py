@@ -15,6 +15,7 @@ bounded by a 1800 s abandoned-not-killed deadline, per-step output in
   4. bench-natural-100mb  enwik8-sized English-text proxy row
   5. bench-zipf-chunk64   64 MB chunks (sort cost is sublinear in rows)
   6. bench-zipf-merge8    BENCH_MERGE_EVERY=8 (K-way batched table merges)
+  7. opshare-sort3/-segmin  per-op profile: sort share of the chunk budget
 
 appending each JSON/log line to --out (default /tmp/benchwatch.log — outside
 the repo tree so snapshot commits never sweep it in), then exits 0 so a
@@ -102,6 +103,11 @@ def main() -> int:
                  {**env, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
                 ("bench-zipf-merge8", [sys.executable, "bench.py"],
                  {**env, "BENCH_MERGE_EVERY": "8"}),
+                ("opshare-sort3", [sys.executable, "tools/opshare.py"], env),
+                ("opshare-segmin", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_SORT_MODE": "segmin"}),
+                ("opshare-merge8", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_MERGE_EVERY": "8"}),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}
