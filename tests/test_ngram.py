@@ -486,3 +486,25 @@ def test_streamed_ngrams_superstep_exact(tmp_path):
     assert result.total == single.total
     assert result.as_dict() == single.as_dict()
     assert result.words == single.words
+
+
+def test_streamed_ngrams_2d_mesh_exact(tmp_path):
+    """Streamed n-grams on a 2-D ('replica','data') mesh: the summary
+    all_gather over the axis TUPLE must order rows exactly like the
+    engine's row-major device-index linearization, or seam windows pair
+    the wrong chunks.  Exactness against single-buffer proves the order."""
+    from mapreduce_tpu.parallel.mesh import two_level_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+    from tests.conftest import make_corpus
+
+    corpus = make_corpus(np.random.default_rng(85), n_words=2000, vocab=100)
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=1024, table_capacity=1 << 14, backend="xla")
+    result = count_file(str(path), config=cfg, mesh=two_level_mesh(2, 4),
+                        ngram=2)
+    single = wordcount.count_ngrams(corpus, 2, Config(table_capacity=1 << 14,
+                                                      backend="xla"))
+    assert result.total == single.total
+    assert result.as_dict() == single.as_dict()
+    assert result.words == single.words
