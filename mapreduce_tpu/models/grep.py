@@ -24,9 +24,12 @@ Envelope (documented, tested):
     ``all_gather`` per step (a few bytes over ICI), and a carry bit in the
     state threads the "current open line already matched" chain across
     steps, so a line counted in one row's trailing segment is not recounted
-    by its continuation rows.  Only the bare per-device
-    :meth:`GrepJob.map_chunk` fallback (no mesh axis available) keeps the
-    old per-row upper bound;
+    by its continuation rows.  The bare per-device :meth:`GrepJob.map_chunk`
+    fallback emits the same transfer terms from its own row summary, so
+    sequential no-axis use (a 1-device mesh, or the protocol driven by hand)
+    is exact too; only mapping rows on parallel devices *without* a mesh
+    axis leaves inter-device seams at the documented upper-bound envelope
+    (off by at most devices-1, like cross-host ``byte_range`` merges);
   * accumulators are 64-bit (uint32 lo/hi pairs with explicit carry — JAX
     default-x64 is off, so device uint64 is unavailable): counts stay exact
     past 2**32 occurrences, where a single uint32 would silently wrap on
@@ -297,6 +300,17 @@ def compile_pattern(pattern: bytes, syntax: str = "literal"):
     return _validate_pattern(pattern)
 
 
+def _single_row_update(matches, seg_cnt, nl, first_m, last_m) -> "GrepUpdate":
+    """Package one row's summary as its own boolean-affine transfer (the
+    no-axis fallback): ``a`` = trailing (or, newline-free, only) segment's
+    match, ``b`` = row has no newline, ``delta`` = leading-segment-matched.
+    Shape-polymorphic: scalar ([]-leaf) and multi-pattern ([P]-leaf)
+    summaries alike."""
+    return GrepUpdate(matches, jnp.zeros_like(matches), seg_cnt, first_m,
+                      jnp.where(nl > 0, last_m, first_m),
+                      (nl == 0).astype(jnp.uint32))
+
+
 def _compose_transfer(x, y):
     """Boolean-affine composition: y applied after x (module docstring)."""
     ax, bx = x
@@ -349,11 +363,22 @@ class GrepJob(MapReduceJob):
         return GrepState(zero, zero, zero, zero, zero)
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepUpdate:
-        """Per-device fallback (no mesh axis): exact within the row, the old
-        upper bound across rows (delta=0 disables the seam correction)."""
-        matches, seg_cnt, _nl, _fm, _lm = _row_summary(chunk, self.pattern)
-        z = jnp.zeros((), jnp.uint32)
-        return GrepUpdate(matches, z, seg_cnt, z, z, z)
+        """Per-device fallback (no mesh axis): the single-row transfer.
+
+        Exactness needs no collective here — one row's boolean-affine
+        transfer ``c' = a | (b & c)`` (module docstring) is computable from
+        its own summary: ``a`` = the trailing (or, newline-free, only)
+        segment's match, ``b`` = row has no newline, and the over-count
+        correction ``delta`` = leading-segment-matched, applied by
+        ``combine`` against the carry threaded through the state.  Driving
+        rows *sequentially* through map_chunk+combine (a 1-device mesh, or
+        the job protocol by hand) is therefore exactly as accurate as the
+        sharded path.  Only when a caller maps rows on PARALLEL devices
+        without a mesh axis do seams between devices degrade ``lines`` to
+        an upper bound (off by at most devices-1) — the same documented
+        envelope as merging independent per-host ``byte_range`` runs
+        (:meth:`merge`)."""
+        return _single_row_update(*_row_summary(chunk, self.pattern))
 
     def map_chunk_sharded(self, chunk: jax.Array, chunk_id: jax.Array,
                           axis, device_index: jax.Array) -> GrepUpdate:
@@ -436,9 +461,8 @@ class MultiGrepJob(GrepJob):
                          jnp.array(z))
 
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> GrepUpdate:
-        matches, seg_cnt, _nl, _fm, _lm = _row_summary_multi(chunk, self.patterns)
-        z = jnp.zeros_like(matches)
-        return GrepUpdate(matches, z, seg_cnt, z, z, z)
+        """Single-row transfer, [P]-shaped: see :meth:`GrepJob.map_chunk`."""
+        return _single_row_update(*_row_summary_multi(chunk, self.patterns))
 
     def map_chunk_sharded(self, chunk: jax.Array, chunk_id: jax.Array,
                           axis, device_index: jax.Array) -> GrepUpdate:
