@@ -352,6 +352,8 @@ def seam_gram_table(prefix: GramCarry, first: GramCarry,
     k_hi, k_lo, cid, pos, cnt, dropped = seam_gram_rows(prefix, first, n)
     length = jnp.where(cnt > 0, jnp.uint32(constants.SEAM_GRAM_LENGTH),
                        jnp.uint32(0))
-    return table_ops._build(k_hi, k_lo, cid, pos, cnt, length,
-                            capacity=max(n - 1, 2),
-                            carry_du=dropped, carry_dc=dropped)
+    z = jnp.uint32(0)
+    return table_ops._build(k_hi, k_lo, cid, pos, cnt, jnp.zeros_like(cnt),
+                            length, capacity=max(n - 1, 2),
+                            carry_du=dropped, carry_du_hi=z,
+                            carry_dc=dropped, carry_dc_hi=z)

@@ -11,8 +11,8 @@ device-wide pass.
 
 Invariants of a well-formed table (established by every constructor here):
   * entries are sorted ascending by 64-bit key;
-  * occupied slots (count > 0) form a prefix; empty slots carry the sentinel
-    key, count 0, pos = +inf, length 0;
+  * occupied slots (``(count | count_hi) > 0``) form a prefix; empty slots
+    carry the sentinel key, count 0, pos = +inf, length 0;
   * ``(pos_hi, pos_lo)`` is the lexicographically smallest (i.e. first)
     occurrence of the key, enabling exact insertion-order reporting and
     host-side string recovery (SURVEY §7);
@@ -20,12 +20,18 @@ Invariants of a well-formed table (established by every constructor here):
     ``dropped_uniques`` an upper bound), never silent corruption like the
     reference past MAX_OUTPUT_COUNT (``main.cu:103-104``).
 
-Count envelope: per-key counts and the ``dropped_*`` scalars are uint32
-device accumulators (JAX default-x64 is off, so uint64 is unavailable on
-device), giving an exact ceiling of 2**32-1 occurrences *per word* and per
-spill counter — ~4.29e9, i.e. ≳30 GB of a single repeated word before wrap.
-Host-side totals (:meth:`CountTable.total_count` on fetched tables) are
-summed in int64 and stay exact across the whole corpus.
+Count envelope: per-key counts and the ``dropped_*`` scalars are exact
+**64-bit** values carried as uint32 lo/hi lane pairs (JAX default-x64 is
+off, so device uint64 is unavailable — the grep accumulator idiom,
+``models/grep.py``).  Batch tables built from one chunk stream never exceed
+2**26 rows, so their hi lanes are structurally zero; the hi lanes earn
+their keep in the running-table ``merge``/``merge_batched`` adds, where a
+single uint32 would silently wrap at ~4.29e9 occurrences per word (~30 GB
+of one repeated word — inside the BASELINE 100 GB envelope).  Wrap is
+silent corruption, the exact failure mode this framework exists to never
+have; every add/sum in this module carries.  Host-side totals
+(:meth:`CountTable.total_count` on fetched tables) are reconstructed
+``hi << 32 | lo`` in int64.
 """
 
 from __future__ import annotations
@@ -41,33 +47,57 @@ from mapreduce_tpu.ops.tokenize import TokenStream
 
 
 class CountTable(NamedTuple):
-    """Keyed count state.  A pytree; all fields are device arrays."""
+    """Keyed count state.  A pytree; all fields are device arrays.
+
+    Counts and dropped scalars are exact 64-bit lo/hi uint32 pairs (module
+    docstring).  Occupancy is ``(count | count_hi) > 0`` — a key holding
+    exactly a multiple of 2**32 occurrences has ``count == 0`` with a
+    nonzero hi lane, so ``count > 0`` alone is NOT an occupancy test.
+    """
 
     key_hi: jax.Array  # uint32[V], sorted (with key_lo) ascending
     key_lo: jax.Array  # uint32[V]
-    count: jax.Array  # uint32[V]
+    count: jax.Array  # uint32[V]  occurrence count, low word
+    count_hi: jax.Array  # uint32[V]  occurrence count, high word
     pos_hi: jax.Array  # uint32[V]  (device,step) buffer id of first occurrence
     pos_lo: jax.Array  # uint32[V]  byte offset within that buffer
     length: jax.Array  # uint32[V]  token length in bytes
     dropped_uniques: jax.Array  # uint32 scalar, >= true number of spilled keys
-    dropped_count: jax.Array  # uint32 scalar, exact token count spilled
+    dropped_count: jax.Array  # uint32 scalar, exact token count spilled (lo)
+    dropped_uniques_hi: jax.Array  # uint32 scalar, high word
+    dropped_count_hi: jax.Array  # uint32 scalar, high word
 
     @property
     def capacity(self) -> int:
         return self.key_hi.shape[0]
 
+    def occupied(self) -> jax.Array:
+        """bool[V]: slots holding a live key (the single occupancy rule)."""
+        return (self.count | self.count_hi) > 0
+
     def n_valid(self) -> jax.Array:
-        return jnp.sum((self.count > 0).astype(jnp.uint32))
+        return jnp.sum(self.occupied().astype(jnp.uint32))
+
+    def dropped_totals(self) -> tuple[int, int]:
+        """Host-side exact ``(dropped_uniques, dropped_count)`` ints from
+        the 64-bit lane pairs (concrete tables only)."""
+        return (int(self.dropped_uniques) + (int(self.dropped_uniques_hi) << 32),
+                int(self.dropped_count) + (int(self.dropped_count_hi) << 32))
 
     def total_count(self) -> jax.Array | int:
         """Total tokens represented, including spilled ones.
 
-        On host tables (numpy leaves, e.g. after fetching a result) the sum is
-        exact in int64; on device the accumulator dtype is uint32 (see module
-        docstring for the envelope), matching what jit can trace.
+        On concrete tables (host numpy leaves, or fetched device arrays)
+        the result is an exact int64 reconstruction of the 64-bit lanes.
+        Under jit tracing the low words alone are summed (no uint64 on
+        device); traced callers needing exact totals past 2**32 should
+        consume the lane pairs directly.
         """
-        if isinstance(self.count, np.ndarray):
-            return int(self.count.astype(np.int64).sum()) + int(self.dropped_count)
+        if not isinstance(self.count, jax.core.Tracer):
+            lo = np.asarray(self.count).astype(np.int64)
+            hi = np.asarray(self.count_hi).astype(np.int64)
+            return int((lo + (hi << np.int64(32))).sum()) \
+                + int(self.dropped_count) + (int(self.dropped_count_hi) << 32)
         return jnp.sum(self.count) + self.dropped_count
 
 
@@ -75,8 +105,40 @@ def empty(capacity: int) -> CountTable:
     sent = jnp.full((capacity,), constants.SENTINEL_KEY, dtype=jnp.uint32)
     zero = jnp.zeros((capacity,), dtype=jnp.uint32)
     inf = jnp.full((capacity,), constants.POS_INF, dtype=jnp.uint32)
-    s0 = jnp.uint32(0)
-    return CountTable(sent, jnp.array(sent), zero, inf, jnp.array(inf), jnp.array(zero), s0, jnp.uint32(0))
+    return CountTable(key_hi=sent, key_lo=jnp.array(sent), count=zero,
+                      count_hi=jnp.array(zero), pos_hi=inf,
+                      pos_lo=jnp.array(inf), length=jnp.array(zero),
+                      dropped_uniques=jnp.uint32(0), dropped_count=jnp.uint32(0),
+                      dropped_uniques_hi=jnp.uint32(0),
+                      dropped_count_hi=jnp.uint32(0))
+
+
+def add64(a_lo, a_hi, b_lo, b_hi):
+    """(lo, hi) + (lo, hi) with carry: exact uint64 in two uint32 lanes.
+    Elementwise — scalars and arrays alike (the grep accumulator idiom)."""
+    lo = a_lo + b_lo
+    return lo, a_hi + b_hi + (lo < a_lo).astype(jnp.uint32)
+
+
+def _sub64(a_lo, a_hi, b_lo, b_hi):
+    """(lo, hi) - (lo, hi) with borrow; caller guarantees a >= b."""
+    return a_lo - b_lo, a_hi - b_hi - (a_lo < b_lo).astype(jnp.uint32)
+
+
+def sum64(lo: jax.Array, hi: jax.Array | None = None):
+    """Exact 64-bit (lo, hi) sum of uint32 lane arrays.
+
+    The low-lane sum wraps; wraps are counted off the running cumsum (a
+    partial sum decreases exactly when the add wrapped, since every addend
+    is < 2**32) and folded into the high word.  The hi-lane sum itself is a
+    plain uint32 sum: overflowing it needs > 2**64 total tokens, i.e. more
+    bytes than the corpus can physically contain.
+    """
+    s = jnp.cumsum(lo)
+    wraps = jnp.sum((s[1:] < s[:-1]).astype(jnp.uint32)) if lo.shape[0] > 1 \
+        else jnp.uint32(0)
+    hi_sum = jnp.sum(hi) if hi is not None else jnp.uint32(0)
+    return s[-1], hi_sum + wraps
 
 
 def _segment_heads(seg: jax.Array, capacity: int) -> jax.Array:
@@ -124,7 +186,8 @@ def _overflow_accounting(sorted_key_hi, sorted_key_lo, seg, capacity: int):
     return jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
 
 
-def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int):
+def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, count_hi,
+                        length, capacity: int):
     """Group-by-key segment reduce of rows already sorted by (key, pos).
 
     Scatter-free (the TPU cost model: even capacity-sized scatters carry a
@@ -135,6 +198,10 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity:
     and the remaining per-key fields are head-row gathers (rows are sorted
     by (key, pos), so the head row of each segment carries the
     lexicographically-first occurrence).
+
+    Counts are 64-bit lane pairs: the low-word cumsum wraps, so the prefix
+    sums carry a running wrap count into the high word (``merge_batched``
+    routes running-table rows with large counts through here).
     """
     _, seg = _segment_boundaries(key_hi, key_lo)
     n = key_hi.shape[0]
@@ -145,17 +212,23 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity:
     head = _segment_heads(seg, capacity)
     fi = jnp.minimum(head[:capacity], n - 1)
 
-    csum = jnp.cumsum(count)  # uint32 inclusive prefix sums
+    csum = jnp.cumsum(count)  # uint32 inclusive prefix sums, wrapping
+    wrapped = jnp.concatenate([jnp.zeros((1,), jnp.uint32),
+                               (csum[1:] < csum[:-1]).astype(jnp.uint32)])
+    csum_hi = jnp.cumsum(count_hi) + jnp.cumsum(wrapped)
 
-    def prefix(h):  # sum of counts in rows [0, h)
-        return jnp.where(h > 0, csum[jnp.maximum(h, 1) - 1], jnp.uint32(0))
+    def prefix(cs, h):  # lane sum of counts in rows [0, h)
+        return jnp.where(h > 0, cs[jnp.maximum(h, 1) - 1], jnp.uint32(0))
 
-    count_u = prefix(head[1:]) - prefix(head[:capacity])
+    count_u, count_hi_u = _sub64(
+        prefix(csum, head[1:]), prefix(csum_hi, head[1:]),
+        prefix(csum, head[:capacity]), prefix(csum_hi, head[:capacity]))
     key_hi_u, key_lo_u = key_hi[fi], key_lo[fi]
-    occupied = (head[:capacity] < n) & (count_u > 0) \
+    occupied = (head[:capacity] < n) & ((count_u | count_hi_u) > 0) \
         & ~((key_hi_u == sent) & (key_lo_u == sent))
 
     count_u = jnp.where(occupied, count_u, jnp.uint32(0))
+    count_hi_u = jnp.where(occupied, count_hi_u, jnp.uint32(0))
     key_hi_u = jnp.where(occupied, key_hi_u, sent)
     key_lo_u = jnp.where(occupied, key_lo_u, sent)
     pos_hi_u = jnp.where(occupied, pos_hi[fi], inf)
@@ -163,23 +236,29 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity:
     len_u = jnp.where(occupied, length[fi], jnp.uint32(0))
 
     dropped_uniques = _overflow_accounting(key_hi, key_lo, seg, capacity)
-    dropped_count = jnp.sum(count) - jnp.sum(count_u)
-    return (key_hi_u, key_lo_u, count_u, pos_hi_u, pos_lo_u, len_u, dropped_uniques, dropped_count)
+    dc_lo, dc_hi = _sub64(csum[-1], csum_hi[-1], *sum64(count_u, count_hi_u))
+    return (key_hi_u, key_lo_u, count_u, count_hi_u, pos_hi_u, pos_lo_u,
+            len_u, dropped_uniques, dc_lo, dc_hi)
 
 
-def _build(key_hi, key_lo, pos_hi, pos_lo, count, length, capacity: int,
-           carry_du, carry_dc) -> CountTable:
+def _build(key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length,
+           capacity: int, carry_du, carry_du_hi, carry_dc,
+           carry_dc_hi) -> CountTable:
     """Sort rows by (key, first-occurrence) and segment-reduce into a table."""
-    key_hi, key_lo, pos_hi, pos_lo, count, length = jax.lax.sort(
-        (key_hi, key_lo, pos_hi, pos_lo, count, length), num_keys=4
+    key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length = jax.lax.sort(
+        (key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length), num_keys=4
     )
-    (key_hi_u, key_lo_u, count_u, pos_hi_u, pos_lo_u, len_u, du, dc) = _reduce_sorted_rows(
-        key_hi, key_lo, pos_hi, pos_lo, count, length, capacity
+    (key_hi_u, key_lo_u, count_u, count_hi_u, pos_hi_u, pos_lo_u, len_u,
+     du, dc, dc_hi) = _reduce_sorted_rows(
+        key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length, capacity
     )
+    du_lo, du_hi = add64(carry_du, carry_du_hi, du, jnp.uint32(0))
+    dc_lo2, dc_hi2 = add64(carry_dc, carry_dc_hi, dc, dc_hi)
     return CountTable(
-        key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,
+        key_hi=key_hi_u, key_lo=key_lo_u, count=count_u, count_hi=count_hi_u,
         pos_hi=pos_hi_u, pos_lo=pos_lo_u, length=len_u,
-        dropped_uniques=carry_du + du, dropped_count=carry_dc + dc,
+        dropped_uniques=du_lo, dropped_count=dc_lo2,
+        dropped_uniques_hi=du_hi, dropped_count_hi=dc_hi2,
     )
 
 
@@ -261,11 +340,16 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     pos_hi_u = jnp.where(occupied, jnp.asarray(pos_hi, jnp.uint32), inf)
 
     dropped_uniques = _overflow_accounting(key_hi, key_lo, rank, capacity)
+    # Single-occurrence rows, <= 2**26 of them: every count fits the low
+    # word, so the hi lanes of this path are structurally zero.
     dropped_count = total - jnp.sum(count_u)
+    zero = jnp.uint32(0)
     return CountTable(
         key_hi=key_hi_u, key_lo=key_lo_u, count=count_u,
+        count_hi=jnp.zeros_like(count_u),
         pos_hi=pos_hi_u, pos_lo=pos_lo_u, length=len_u,
         dropped_uniques=dropped_uniques, dropped_count=dropped_count,
+        dropped_uniques_hi=zero, dropped_count_hi=zero,
     )
 
 
@@ -312,8 +396,10 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
     n = stream.key_hi.shape[0]
     ph = jnp.full((n,), jnp.asarray(pos_hi, dtype=jnp.uint32))
     ph = jnp.where(stream.count > 0, ph, jnp.uint32(constants.POS_INF))
+    z = jnp.uint32(0)
     return _build(stream.key_hi, stream.key_lo, ph, stream.pos, stream.count,
-                  stream.length, capacity, jnp.uint32(0), jnp.uint32(0))
+                  jnp.zeros_like(stream.count), stream.length, capacity,
+                  z, z, z, z)
 
 
 def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTable:
@@ -332,10 +418,11 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
     cat = lambda f, g: jnp.concatenate([f, g])
-    key_hi, key_lo, pos_hi, pos_lo, count, length = jax.lax.sort(
+    key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length = jax.lax.sort(
         (cat(a.key_hi, b.key_hi), cat(a.key_lo, b.key_lo),
          cat(a.pos_hi, b.pos_hi), cat(a.pos_lo, b.pos_lo),
-         cat(a.count, b.count), cat(a.length, b.length)),
+         cat(a.count, b.count), cat(a.count_hi, b.count_hi),
+         cat(a.length, b.length)),
         num_keys=4,  # (key, pos): the head row of a pair carries first occurrence
     )
 
@@ -343,12 +430,17 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     false1 = jnp.zeros((1,), jnp.bool_)
     follower = jnp.concatenate([false1, eq_next])  # same key as previous row
     has_next = jnp.concatenate([eq_next, false1])  # next row is my follower
-    next_count = jnp.concatenate([count[1:], jnp.zeros((1,), jnp.uint32)])
+    zero1 = jnp.zeros((1,), jnp.uint32)
+    next_count = jnp.concatenate([count[1:], zero1])
+    next_count_hi = jnp.concatenate([count_hi[1:], zero1])
 
     is_empty = (key_hi == sent) & (key_lo == sent)
-    head = ~follower & ~is_empty & (count > 0)
-    count_m = jnp.where(head, count + jnp.where(has_next, next_count, jnp.uint32(0)),
-                        jnp.uint32(0))
+    head = ~follower & ~is_empty & ((count | count_hi) > 0)
+    folded_lo, folded_hi = add64(count, count_hi,
+                                 jnp.where(has_next, next_count, jnp.uint32(0)),
+                                 jnp.where(has_next, next_count_hi, jnp.uint32(0)))
+    count_m = jnp.where(head, folded_lo, jnp.uint32(0))
+    count_hi_m = jnp.where(head, folded_hi, jnp.uint32(0))
     key_hi_m = jnp.where(head, key_hi, sent)
     key_lo_m = jnp.where(head, key_lo, sent)
     pos_hi_m = jnp.where(head, pos_hi, inf)
@@ -358,28 +450,38 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     # Second sort: unique live keys ascending, sentinel holes to the tail;
     # the first `cap` rows are the result (spill = largest keys, matching the
     # rank-based reduce's drop order).
-    key_hi_s, key_lo_s, count_s, pos_hi_s, pos_lo_s, len_s = jax.lax.sort(
-        (key_hi_m, key_lo_m, count_m, pos_hi_m, pos_lo_m, len_m), num_keys=2)
+    key_hi_s, key_lo_s, count_s, count_hi_s, pos_hi_s, pos_lo_s, len_s = \
+        jax.lax.sort((key_hi_m, key_lo_m, count_m, count_hi_m, pos_hi_m,
+                      pos_lo_m, len_m), num_keys=2)
     n = key_hi_s.shape[0]
     if n < cap:  # explicit capacity above the inputs' sum: pad with holes
         pad = cap - n
         key_hi_s = jnp.concatenate([key_hi_s, jnp.full((pad,), sent)])
         key_lo_s = jnp.concatenate([key_lo_s, jnp.full((pad,), sent)])
         count_s = jnp.concatenate([count_s, jnp.zeros((pad,), jnp.uint32)])
+        count_hi_s = jnp.concatenate([count_hi_s, jnp.zeros((pad,), jnp.uint32)])
         pos_hi_s = jnp.concatenate([pos_hi_s, jnp.full((pad,), inf)])
         pos_lo_s = jnp.concatenate([pos_lo_s, jnp.full((pad,), inf)])
         len_s = jnp.concatenate([len_s, jnp.zeros((pad,), jnp.uint32)])
 
-    kept = count_s[:cap]
+    kept_lo, kept_hi = count_s[:cap], count_hi_s[:cap]
     n_live = jnp.sum(head.astype(jnp.uint32))
     spilled_uniques = jnp.where(n_live > jnp.uint32(cap),
                                 n_live - jnp.uint32(cap), jnp.uint32(0))
-    spilled_count = jnp.sum(count) - jnp.sum(kept)
+    spill_lo, spill_hi = _sub64(*sum64(count, count_hi),
+                                *sum64(kept_lo, kept_hi))
+    du_lo, du_hi = add64(a.dropped_uniques, a.dropped_uniques_hi,
+                         b.dropped_uniques, b.dropped_uniques_hi)
+    du_lo, du_hi = add64(du_lo, du_hi, spilled_uniques, jnp.uint32(0))
+    dc_lo, dc_hi = add64(a.dropped_count, a.dropped_count_hi,
+                         b.dropped_count, b.dropped_count_hi)
+    dc_lo, dc_hi = add64(dc_lo, dc_hi, spill_lo, spill_hi)
     return CountTable(
-        key_hi=key_hi_s[:cap], key_lo=key_lo_s[:cap], count=kept,
+        key_hi=key_hi_s[:cap], key_lo=key_lo_s[:cap],
+        count=kept_lo, count_hi=kept_hi,
         pos_hi=pos_hi_s[:cap], pos_lo=pos_lo_s[:cap], length=len_s[:cap],
-        dropped_uniques=a.dropped_uniques + b.dropped_uniques + spilled_uniques,
-        dropped_count=a.dropped_count + b.dropped_count + spilled_count,
+        dropped_uniques=du_lo, dropped_count=dc_lo,
+        dropped_uniques_hi=du_hi, dropped_count_hi=dc_hi,
     )
 
 
@@ -399,13 +501,18 @@ def merge_batched(table: CountTable, pend_key_hi, pend_key_lo, pend_count,
     per flush, not once per step).
     """
     cat = lambda a, b: jnp.concatenate([a, b])
+    # Pending rows are staged BATCH-table rows (single-chunk builds), whose
+    # hi lanes are structurally zero; only the running table's hi lane
+    # carries real bits into the fold.
     return _build(cat(table.key_hi, pend_key_hi),
                   cat(table.key_lo, pend_key_lo),
                   cat(table.pos_hi, pend_pos_hi),
                   cat(table.pos_lo, pend_pos_lo),
                   cat(table.count, pend_count),
+                  cat(table.count_hi, jnp.zeros_like(pend_count)),
                   cat(table.length, pend_length),
-                  capacity, table.dropped_uniques, table.dropped_count)
+                  capacity, table.dropped_uniques, table.dropped_uniques_hi,
+                  table.dropped_count, table.dropped_count_hi)
 
 
 def update(table: CountTable, stream: TokenStream, batch_capacity: int,
@@ -434,8 +541,8 @@ def kmv_distinct(table: CountTable) -> float | None:
     >W-byte tokens never hash, so their distinct count (folded into
     ``dropped_uniques``'s bound) is not part of the estimate.
     """
-    count = np.asarray(table.count)
-    n_valid = int((count > 0).sum())
+    occ = (np.asarray(table.count) > 0) | (np.asarray(table.count_hi) > 0)
+    n_valid = int(occ.sum())
     if n_valid < table.capacity or n_valid < 2:
         return None
     kth = (int(np.asarray(table.key_hi)[n_valid - 1]) << 32) \
@@ -455,15 +562,25 @@ def top_k(table: CountTable, k: int) -> CountTable:
     :func:`mapreduce_tpu.models.wordcount.apply_top_k` so streamed and
     single-buffer runs report identical word sets.
     """
-    neg = jnp.uint32(0xFFFFFFFF) - table.count
-    order = jnp.lexsort((table.pos_lo, table.pos_hi, neg))[:k]
+    # Count-descending = ascending bitwise complement, hi lane primary
+    # (lexsort's LAST key is the most significant).
+    neg_lo = jnp.uint32(0xFFFFFFFF) - table.count
+    neg_hi = jnp.uint32(0xFFFFFFFF) - table.count_hi
+    order = jnp.lexsort((table.pos_lo, table.pos_hi, neg_lo, neg_hi))[:k]
     take = lambda f: f[order]
-    kept_count = take(table.count)
-    evicted_count = jnp.sum(table.count) - jnp.sum(kept_count)
-    evicted_uniques = table.n_valid() - jnp.sum((kept_count > 0).astype(jnp.uint32))
+    kept_lo, kept_hi = take(table.count), take(table.count_hi)
+    ev_lo, ev_hi = _sub64(*sum64(table.count, table.count_hi),
+                          *sum64(kept_lo, kept_hi))
+    evicted_uniques = table.n_valid() \
+        - jnp.sum(((kept_lo | kept_hi) > 0).astype(jnp.uint32))
+    du_lo, du_hi = add64(table.dropped_uniques, table.dropped_uniques_hi,
+                         evicted_uniques, jnp.uint32(0))
+    dc_lo, dc_hi = add64(table.dropped_count, table.dropped_count_hi,
+                         ev_lo, ev_hi)
     return CountTable(
-        key_hi=take(table.key_hi), key_lo=take(table.key_lo), count=kept_count,
+        key_hi=take(table.key_hi), key_lo=take(table.key_lo),
+        count=kept_lo, count_hi=kept_hi,
         pos_hi=take(table.pos_hi), pos_lo=take(table.pos_lo), length=take(table.length),
-        dropped_uniques=table.dropped_uniques + evicted_uniques,
-        dropped_count=table.dropped_count + evicted_count,
+        dropped_uniques=du_lo, dropped_count=dc_lo,
+        dropped_uniques_hi=du_hi, dropped_count_hi=dc_hi,
     )

@@ -286,12 +286,13 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
     device knew the start but not the end (it lies in a later chunk), so the
     span length is recovered here by scanning ``ngram`` tokens forward.
     """
-    count = np.asarray(tbl.count)
-    valid = count > 0
+    count = np.asarray(tbl.count).astype(np.int64)
+    count_hi = np.asarray(tbl.count_hi).astype(np.int64)
+    valid = (count > 0) | (count_hi > 0)
     chunk_id = np.asarray(tbl.pos_hi)[valid].astype(np.int64)
     pos = np.asarray(tbl.pos_lo)[valid].astype(np.int64)
     length = np.asarray(tbl.length)[valid].astype(np.int64)
-    cnt = count[valid]
+    cnt = (count + (count_hi << np.int64(32)))[valid]
     absolute = absolute_offsets(chunk_id, pos, bases, n_devices)
     seam = np.flatnonzero(length == int(constants.SEAM_GRAM_LENGTH))
     if len(seam):
@@ -302,7 +303,7 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
     order = np.argsort(absolute, kind="stable")
     spans = [(int(absolute[i]), int(length[i])) for i in order]
     words = reader_mod.read_words_at_multi(path, spans)
-    dropped_uniques = int(np.asarray(tbl.dropped_uniques))
+    dropped_uniques, dropped_count = tbl.dropped_totals()
     return WordCountResult(
         words=words,
         counts=[int(c) for c in cnt[order]],
@@ -310,7 +311,7 @@ def recover_from_file(tbl: table_ops.CountTable, path, bases: np.ndarray,
         distinct=_reported_distinct(tbl, len(words), dropped_uniques,
                                     estimate_distinct),
         dropped_uniques=dropped_uniques,
-        dropped_count=int(np.asarray(tbl.dropped_count)),
+        dropped_count=dropped_count,
     )
 
 
