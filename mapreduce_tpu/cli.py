@@ -104,6 +104,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "once every K steps (one K-way reduce replaces K "
                         "pairwise merges; word-count family only; kept "
                         "counts identical)")
+    p.add_argument("--merge-strategy", choices=("tree", "gather", "keyrange"),
+                   default="tree",
+                   help="collective global-reduce strategy for streamed "
+                        "word-count runs: butterfly tree (log2(D) rounds), "
+                        "all_gather + fold, or key-range all_to_all "
+                        "reduce-scatter (one round; the pod-scale choice)")
     p.add_argument("--sort-mode", choices=("sort3", "segmin"), default="sort3",
                    help="aggregation sort strategy on the pallas fast path "
                         "(bit-identical results; 'segmin' trades the third "
@@ -350,6 +356,14 @@ def main(argv: list[str] | None = None) -> int:
         # Honest failure beats a knob silently ignored: the single-buffer
         # path has no per-step merges to batch.
         parser.error("--merge-every requires --stream")
+    if args.merge_strategy != "tree":
+        # Same honesty rule: the collective strategy only exists on the
+        # streamed word-count path (grep/sample states ride psum-like
+        # merges; the single-buffer path has no collective at all).
+        if not args.stream:
+            parser.error("--merge-strategy requires --stream")
+        if args.grep is not None or args.sample is not None:
+            parser.error("--merge-strategy applies to word-count runs only")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -438,6 +452,7 @@ def main(argv: list[str] | None = None) -> int:
                                 distinct_sketch=args.distinct_sketch,
                                 count_sketch=args.count_sketch or bool(args.estimate),
                                 ngram=args.ngram,
+                                merge_strategy=args.merge_strategy,
                                 checkpoint_path=args.checkpoint,
                                 checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
                                 retry=args.retry)

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.ops.table import add64 as _add64
 from mapreduce_tpu.parallel.mapreduce import MapReduceJob
 
 
@@ -86,13 +87,6 @@ class GrepUpdate(NamedTuple):
     delta: jax.Array
     blk_a: jax.Array
     blk_b: jax.Array
-
-
-def _add64(a_lo, a_hi, b_lo, b_hi):
-    """(lo, hi) + (lo, hi) with carry: exact uint64 in two uint32 lanes."""
-    lo = a_lo + b_lo
-    carry = (lo < a_lo).astype(jnp.uint32)
-    return lo, a_hi + b_hi + carry
 
 
 class ClassPattern:

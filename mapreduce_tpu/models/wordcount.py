@@ -143,14 +143,15 @@ def _reported_distinct(tbl: table_ops.CountTable, n_words: int,
 def recover_result(tbl: table_ops.CountTable, source: bytes,
                    estimate_distinct: bool = True) -> WordCountResult:
     """Host-side string recovery from a single-buffer table (pos_hi == 0)."""
-    count = np.asarray(tbl.count)
-    valid = count > 0
+    count = np.asarray(tbl.count).astype(np.int64)
+    count_hi = np.asarray(tbl.count_hi).astype(np.int64)
+    valid = (count > 0) | (count_hi > 0)
     pos = np.asarray(tbl.pos_lo)[valid]
     length = np.asarray(tbl.length)[valid]
-    cnt = count[valid]
+    cnt = (count + (count_hi << np.int64(32)))[valid]
     order = np.argsort(pos, kind="stable")
     words = [bytes(source[int(p): int(p) + int(l)]) for p, l in zip(pos[order], length[order])]
-    dropped_uniques = int(np.asarray(tbl.dropped_uniques))
+    dropped_uniques, dropped_count = tbl.dropped_totals()
     return WordCountResult(
         words=words,
         counts=[int(c) for c in cnt[order]],
@@ -158,7 +159,7 @@ def recover_result(tbl: table_ops.CountTable, source: bytes,
         distinct=_reported_distinct(tbl, len(words), dropped_uniques,
                                     estimate_distinct),
         dropped_uniques=dropped_uniques,
-        dropped_count=int(np.asarray(tbl.dropped_count)),
+        dropped_count=dropped_count,
     )
 
 
@@ -270,10 +271,17 @@ class WordCountJob:
             state.cursor + jnp.uint32(1))
         # Spilled batch accounting must not wait for the flush: the batch
         # table's own dropped_* scalars fold into the running table NOW
-        # (merge_batched only carries the table's scalars).
+        # (merge_batched only carries the table's scalars).  Carry adds:
+        # the running scalars are 64-bit lane pairs.
+        du_lo, du_hi = table_ops.add64(
+            st.table.dropped_uniques, st.table.dropped_uniques_hi,
+            update.dropped_uniques, update.dropped_uniques_hi)
+        dc_lo, dc_hi = table_ops.add64(
+            st.table.dropped_count, st.table.dropped_count_hi,
+            update.dropped_count, update.dropped_count_hi)
         st = st._replace(table=st.table._replace(
-            dropped_uniques=st.table.dropped_uniques + update.dropped_uniques,
-            dropped_count=st.table.dropped_count + update.dropped_count))
+            dropped_uniques=du_lo, dropped_uniques_hi=du_hi,
+            dropped_count=dc_lo, dropped_count_hi=dc_hi))
         return jax.lax.cond(st.cursor >= jnp.uint32(self.merge_every),
                             self._flushed, lambda s: s, st)
 
@@ -289,6 +297,16 @@ class WordCountJob:
         if isinstance(state, BufferedTableState):
             return self._flushed(state).table
         return state
+
+    def keyrange_merge(self, state, axis) -> table_ops.CountTable:
+        """Collective global reduce via key-range all_to_all (the
+        ``merge_strategy='keyrange'`` Engine hook): fold any pending
+        batches locally, then one reduce-scatter + all_gather round
+        (:func:`...parallel.collectives.key_range_merge`).  Returns the
+        plain replicated CountTable; ``finalize`` accepts both shapes."""
+        from mapreduce_tpu.parallel import collectives
+
+        return collectives.key_range_merge(self._plain_table(state), axis)
 
     def finalize(self, state):
         return self._plain_table(state)
@@ -456,6 +474,15 @@ class NGramCountJob(WordCountJob):
         return NGramState(
             table=table_ops.merge(a.table, b.table, capacity=self.capacity),
             carry=a.carry)
+
+    def keyrange_merge(self, state, axis) -> table_ops.CountTable:
+        """Key-range reduce of the gram table (the carry is spent once
+        every chunk's combine has run; only the table crosses devices)."""
+        if self.n == 1:
+            return super().keyrange_merge(state, axis)
+        from mapreduce_tpu.parallel import collectives
+
+        return collectives.key_range_merge(state.table, axis)
 
     def on_input_boundary(self, state):
         """Files are independent corpora: grams must not span a file seam.
@@ -631,13 +658,31 @@ class _SketchComposedJob:
             self._merge(fa.sketch, fb.sketch),
             fa.pend_hi, fa.pend_lo, fa.pend_cnt, fa.cursor)
 
+    def keyrange_merge(self, state, axis):
+        """Compose the base job's key-range table reduce with the sketch's
+        own monoid over the axis (tree-merge of the small sketch array —
+        its cost is noise next to the table exchange)."""
+        from mapreduce_tpu.parallel import collectives
+
+        if self.flush_every == 1:
+            table_state, sketch = state[0], state[1]
+        else:
+            st = self._flushed(state)
+            table_state, sketch = st.table, st.sketch
+        return self.state_cls(
+            self.base.keyrange_merge(table_state, axis),
+            collectives.tree_merge(sketch, self._merge, axis))
+
     def finalize(self, state):
         if self.flush_every == 1:
             return self.state_cls(self.base.finalize(state[0]), state[1])
-        st = self._flushed(state)
-        # Downstream (executor result unwrapping, checkpoint-of-results)
-        # sees the same plain state shape as unbatched runs.
-        return self.state_cls(self.base.finalize(st.table), st.sketch)
+        if isinstance(state, BatchedSketchState):
+            st = self._flushed(state)
+            # Downstream (executor result unwrapping, checkpoint-of-results)
+            # sees the same plain state shape as unbatched runs.
+            return self.state_cls(self.base.finalize(st.table), st.sketch)
+        # Already a plain state_cls (the keyrange hook returns one).
+        return self.state_cls(self.base.finalize(state[0]), state[1])
 
     def identity(self) -> str:
         # flush_every changes state SHAPE but not results; shapes are

@@ -12,11 +12,18 @@ slices), in three interchangeable strategies:
   The generalization of ``psum`` to non-additive monoids (count tables).
 * :func:`gather_merge` — ``all_gather`` + fold.  Works for any axis size;
   O(D) memory; the fallback and the simplest correct form.
+* :func:`key_range_merge` — the pod-scale strategy for :class:`CountTable`
+  states specifically: reduce-scatter by hash range (one ``all_to_all``,
+  capacity/D-sized owner merges) + ``all_gather`` of the already-reduced
+  blocks.  One communication round where the butterfly does log2(D)
+  sequential full-table rounds; see the function docstring for the traffic
+  arithmetic and the exactness argument.
 * ``psum`` — used directly wherever the state really is additive (scalar
   totals, sketch matrices, histogram vectors); XLA lowers it to the native
   ICI all-reduce (the BASELINE.json north-star transformation).
 
-All functions take *pytrees* and must be called inside ``shard_map``.
+All functions take *pytrees* (``key_range_merge``: a CountTable) and must be
+called inside ``shard_map``.
 """
 
 from __future__ import annotations
@@ -25,6 +32,8 @@ from typing import Any, Callable, TypeVar
 
 import jax
 import jax.numpy as jnp
+
+from mapreduce_tpu.ops import table as table_ops
 
 T = TypeVar("T")
 MergeFn = Callable[[T, T], T]
@@ -60,6 +69,130 @@ def gather_merge(state: T, merge: MergeFn, axis: str) -> T:
 def psum(state: T, axis: str) -> T:
     """Additive all-reduce of a pytree (native XLA collective)."""
     return jax.lax.psum(state, axis)
+
+
+def psum64(lo: jax.Array, hi: jax.Array, axis) -> tuple[jax.Array, jax.Array]:
+    """Exact 64-bit all-reduce sum of uint32 (lo, hi) lane-pair scalars.
+
+    A plain ``psum`` of the low lanes would drop inter-device carries
+    silently; instead the D scalars are gathered (a few bytes) and folded
+    with the wrap-counting :func:`...ops.table.sum64`."""
+    return table_ops.sum64(jax.lax.all_gather(lo, axis),
+                           jax.lax.all_gather(hi, axis))
+
+
+def key_range_merge(table: table_ops.CountTable, axis,
+                    slack: float = 2.0) -> table_ops.CountTable:
+    """Key-range sharded global reduce of per-device CountTables: the
+    reduce-scatter formulation of the serial reduce the reference runs on
+    one thread (``main.cu:119-123``), for pod scale.
+
+    The tree/butterfly strategy moves each device's FULL table log2(D)
+    sequential times and runs log2(D) full-capacity merge sorts.  Here the
+    key space is partitioned over the axis, every device routes each row to
+    its owner in ONE ``all_to_all``, owners reduce their (capacity/D-scale)
+    partition locally, and one ``all_gather`` of the already-reduced blocks
+    replicates the result.  Per device, with table bytes M and slack s:
+
+    =========  ====================  =====================================
+    strategy   bytes moved           sequential sort rows
+    =========  ====================  =====================================
+    tree       M * log2(D)           2C * log2(D)
+    keyrange   s*M (a2a) + s*M (ag)  C (pack) + s*C (owner) + s*C (final)
+    =========  ====================  =====================================
+
+    At D=256, C=256K, M~7 MB, s=2: ~56 MB & 4.2M sequential sort rows
+    (tree) vs ~28 MB & ~1.3M rows (keyrange) — and the all_to_all round is
+    a single collective XLA schedules across ICI links at once, not log2(D)
+    dependent steps.
+
+    Partitioning is by ``key_lo % D``: tables keep the capacity SMALLEST
+    (key_hi, key_lo) keys, so key_hi ranges are mass-skewed toward small
+    values, while the second hash word stays uniform under that selection.
+
+    Exactness: each destination block has a fixed budget B = ceil(s*C/D)
+    rows; a device whose partition overflows B spills its LARGEST keys
+    past the budget (rank order = key order).  Spilling key k implies >= B
+    smaller distinct keys in that partition, all of which reach the owner,
+    whose capacity-B reduce then evicts k everywhere it survived — so a
+    spilled key is never reported with a partial count: it is fully
+    evicted and accounted in ``dropped_*``, the same contract as capacity
+    spill (ops/table.py module docstring).  With hash-uniform keys,
+    P(partition load > 2C/D) is Chernoff-negligible, so in practice (and
+    in every no-spill run) the result is bit-identical to tree/gather.
+
+    Works for any axis size (not just powers of two) and for tuple axes
+    (the mesh is flattened; the single a2a round trades the ICI/DCN
+    hierarchy for one scheduled collective).
+    """
+    d = jax.lax.axis_size(axis)
+    cap = table.capacity
+    if d == 1:
+        return table
+    b = min(cap, -(-int(slack * cap) // d))
+    sent = jnp.uint32(table_ops.constants.SENTINEL_KEY)
+    inf = jnp.uint32(table_ops.constants.POS_INF)
+    zero = jnp.uint32(0)
+
+    # 1. Pack: sort rows by (owner, key); dead rows get owner D (sorts last,
+    #    never sent).  Keys are unique within a table, so (owner, key_hi,
+    #    key_lo) is already a total order; pos/count lanes ride as payload.
+    owner = jnp.where(table.occupied(),
+                      table.key_lo % jnp.uint32(d), jnp.uint32(d))
+    own_s, khi, klo, cnt, cnth, phi, plo, ln = jax.lax.sort(
+        (owner, table.key_hi, table.key_lo, table.count, table.count_hi,
+         table.pos_hi, table.pos_lo, table.length), num_keys=3)
+    own_i = own_s.astype(jnp.int32)
+    # heads[q] = first sorted row with owner >= q (q = 0..D; owner values
+    # are sorted, which is all the shared binary search needs).
+    heads = table_ops._segment_heads(own_i, d)
+
+    # Destination slot t of block j holds partition j's rank-t row.
+    slot = jnp.arange(d * b, dtype=jnp.int32)
+    j, r = slot // b, slot % b
+    src = heads[j] + r
+    valid = src < heads[j + 1]
+    srcc = jnp.minimum(src, cap - 1)
+    take = lambda a, fill: jnp.where(valid, a[srcc], fill)
+    s_khi, s_klo = take(khi, sent), take(klo, sent)
+    s_cnt, s_cnth = take(cnt, zero), take(cnth, zero)
+    s_phi, s_plo = take(phi, inf), take(plo, inf)
+    s_ln = take(ln, zero)
+
+    # Budget spill: within-partition rank >= B — deterministically the
+    # partition's largest keys (see docstring for why this stays exact).
+    rank = jnp.arange(cap, dtype=jnp.int32) - heads[jnp.minimum(own_i, d)]
+    spilled = (own_i < d) & (rank >= b)
+    sp_u = jnp.sum(spilled.astype(jnp.uint32))
+    sp_lo, sp_hi = table_ops.sum64(jnp.where(spilled, cnt, zero),
+                                   jnp.where(spilled, cnth, zero))
+
+    # 2. Exchange: block j goes to device j; block s received from source s.
+    def a2a(a):
+        return jax.lax.all_to_all(a.reshape(d, b), axis,
+                                  split_axis=0, concat_axis=0).reshape(d * b)
+
+    # 3. Owner reduce: all sources' rows of MY partition -> capacity B.
+    mine = table_ops._build(a2a(s_khi), a2a(s_klo), a2a(s_phi), a2a(s_plo),
+                            a2a(s_cnt), a2a(s_cnth), a2a(s_ln), b,
+                            zero, zero, zero, zero)
+
+    # 4. Replicate: gather every owner's reduced block, final reduce to C.
+    ag = lambda a: jax.lax.all_gather(a, axis).reshape(d * b)
+    du_lo, du_hi = table_ops.add64(table.dropped_uniques,
+                                   table.dropped_uniques_hi, sp_u, zero)
+    dc_lo, dc_hi = table_ops.add64(table.dropped_count,
+                                   table.dropped_count_hi, sp_lo, sp_hi)
+    du_lo, du_hi = table_ops.add64(du_lo, du_hi, mine.dropped_uniques,
+                                   mine.dropped_uniques_hi)
+    dc_lo, dc_hi = table_ops.add64(dc_lo, dc_hi, mine.dropped_count,
+                                   mine.dropped_count_hi)
+    gdu_lo, gdu_hi = psum64(du_lo, du_hi, axis)
+    gdc_lo, gdc_hi = psum64(dc_lo, dc_hi, axis)
+    return table_ops._build(ag(mine.key_hi), ag(mine.key_lo), ag(mine.pos_hi),
+                            ag(mine.pos_lo), ag(mine.count), ag(mine.count_hi),
+                            ag(mine.length), cap,
+                            gdu_lo, gdu_hi, gdc_lo, gdc_hi)
 
 
 def hierarchical_merge(state: T, merge: MergeFn, axes: tuple[str, ...],

@@ -123,15 +123,25 @@ class Engine:
         self.n_devices = 1
         for a in axes:
             self.n_devices *= mesh.shape[a]
-        if merge_strategy not in ("tree", "gather"):
+        if merge_strategy not in ("tree", "gather", "keyrange"):
             raise ValueError(f"unknown merge_strategy {merge_strategy!r}")
+        if merge_strategy == "keyrange" \
+                and getattr(job, "keyrange_merge", None) is None:
+            raise ValueError(
+                "merge_strategy='keyrange' needs a job with a keyrange_merge "
+                "hook (the CountTable wordcount family); use 'tree'/'gather' "
+                f"for {type(job).__name__}")
+        self._keyrange = merge_strategy == "keyrange"
         # Multi-axis meshes reduce level by level (innermost = fastest link
         # first); single-axis meshes use the chosen strategy directly.
-        self._collective = functools.partial(
-            collectives.hierarchical_merge, strategy=merge_strategy) \
-            if len(axes) > 1 else \
+        # Keyrange flattens the axes inside its single all_to_all round
+        # (the job hook receives the full axis tuple).
+        self._collective = None if self._keyrange else (
+            functools.partial(
+                collectives.hierarchical_merge, strategy=merge_strategy)
+            if len(axes) > 1 else
             (collectives.tree_merge if merge_strategy == "tree"
-             else collectives.gather_merge)
+             else collectives.gather_merge))
         self._sharded = mesh_mod.sharded(mesh, axes if len(axes) > 1 else axes[0])
         self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
@@ -224,7 +234,10 @@ class Engine:
 
         def final(state):
             local = jax.tree.map(lambda x: x[0], state)
-            merged = self._collective(local, job.merge, axis)
+            if self._keyrange:
+                merged = job.keyrange_merge(local, axis)
+            else:
+                merged = self._collective(local, job.merge, axis)
             return job.finalize(merged)
 
         fn = shard_map(
