@@ -110,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "word-count runs: butterfly tree (log2(D) rounds), "
                         "all_gather + fold, or key-range all_to_all "
                         "reduce-scatter (one round; the pod-scale choice)")
+    p.add_argument("--compact-slots", type=int, default=0, metavar="S",
+                   help="slot-compact the pallas kernel's output to S rows "
+                        "per 256-byte window (multiple of 8; 0 = off). Cuts "
+                        "the aggregation sort's input ~1.45x at S=88; "
+                        "windows denser than S fall back to the full path "
+                        "for that chunk (always exact)")
     p.add_argument("--sort-mode", choices=("sort3", "segmin"), default="sort3",
                    help="aggregation sort strategy on the pallas fast path "
                         "(bit-identical results; 'segmin' trades the third "
@@ -396,7 +402,8 @@ def main(argv: list[str] | None = None) -> int:
                         pallas_max_token=args.max_token_bytes,
                         sketch_flush_every=args.sketch_flush_every,
                         sort_mode=args.sort_mode,
-                        merge_every=args.merge_every)
+                        merge_every=args.merge_every,
+                        compact_slots=args.compact_slots)
     except ValueError as e:
         parser.error(str(e))
 

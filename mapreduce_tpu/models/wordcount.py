@@ -88,23 +88,46 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
     if config.resolved_backend() == "pallas":
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
-        # One aggregation over column + seam emissions together: the seam
-        # rows are ~8.5K entries, absorbed by the big sort for free, where a
-        # separate seam table + merge cost a second (fixed-overhead-bound)
-        # reduce pass per chunk.
-        col, seam, overlong = pallas_tok.tokenize_split(
-            chunk, max_token_bytes=config.pallas_max_token)
-        stream = pallas_tok.concat_streams(col, seam)
-        t = table_ops.from_stream(
-            stream, capacity, pos_hi=pos_hi,
-            max_token_bytes=config.pallas_max_token,
-            max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode)
-        # ``overlong`` counts occurrences.  For dropped_count (occurrences)
-        # that is exact; for dropped_uniques it is the only available upper
-        # bound — overlong tokens leave the kernel unhashed, so distinct
-        # overlong words cannot be deduplicated on device.
-        return t._replace(dropped_uniques=t.dropped_uniques + overlong,
-                          dropped_count=t.dropped_count + overlong)
+        def aggregate(col, seam, overlong):
+            # One aggregation over column + seam emissions together: the
+            # seam rows are ~8.5K entries, absorbed by the big sort for
+            # free, where a separate seam table + merge cost a second
+            # (fixed-overhead-bound) reduce pass per chunk.
+            stream = pallas_tok.concat_streams(col, seam)
+            t = table_ops.from_stream(
+                stream, capacity, pos_hi=pos_hi,
+                max_token_bytes=config.pallas_max_token,
+                max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode)
+            # ``overlong`` counts occurrences.  For dropped_count
+            # (occurrences) that is exact; for dropped_uniques it is the
+            # only available upper bound — overlong tokens leave the kernel
+            # unhashed, so distinct overlong words cannot be deduplicated
+            # on device.
+            return t._replace(dropped_uniques=t.dropped_uniques + overlong,
+                              dropped_count=t.dropped_count + overlong)
+
+        def full_path(_):
+            col, seam, overlong = pallas_tok.tokenize_split(
+                chunk, max_token_bytes=config.pallas_max_token)
+            return aggregate(col, seam, overlong)
+
+        if not config.compact_slots:
+            return full_path(None)
+        # Slot-compacted planes (config.compact_slots): the sort input
+        # shrinks ~1.45x.  A nonzero spill means some (block, lane) window
+        # exceeded its slot budget and the compact planes are incomplete —
+        # the cond then re-runs the chunk at full resolution, so ANY input
+        # stays exact (the compact branch is bit-identical when it runs;
+        # tools/density.py: the default budget never spills on the bench
+        # corpora).
+        col, seam, overlong, spill = pallas_tok.tokenize_split_compact(
+            chunk, config.compact_slots,
+            max_token_bytes=config.pallas_max_token)
+        return jax.lax.cond(
+            spill == 0,
+            lambda _: aggregate(col, seam, overlong),
+            full_path,
+            None)
     stream = tok_ops.tokenize(chunk)
     return table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
 

@@ -101,8 +101,53 @@ def _sep_mask_i32(x: jax.Array) -> jax.Array:
     return sep
 
 
+def _compact_planes(khi, klo, packed, has, slots: int):
+    """In-VMEM slot compaction of pair-resolution planes (VERDICT r4 #2).
+
+    ``has`` marks live pair rows (emission or poison).  Per lane, live rows
+    keep their order and pack into the first ``rank`` output slots; the
+    rest fill with the all-ones sentinel.  Rank comes from a log-shift
+    cumsum along sublanes; selection is a one-hot masked sum per slot —
+    exactly one row per (slot, lane) matches, so the int32 "sum" is a pure
+    bit-preserving select (Mosaic cannot reduce unsigned ints; a one-hot
+    sum never actually adds).  Work is bounded by the r >= s triangle:
+    rank[r] <= r+1, so slot s can only come from rows >= s.
+
+    Returns (khi[slots,L], klo[slots,L], packed[slots,L], n_spilled) where
+    n_spilled counts live rows beyond the per-lane budget — the caller's
+    exactness fallback trigger.
+    """
+    p = has.shape[0]
+    rank = has.astype(jnp.int32)
+    k = 1
+    while k < p:  # inclusive cumsum along sublanes: log-shift adds
+        top = jnp.zeros((k, rank.shape[1]), jnp.int32)
+        rank = rank + jnp.concatenate([top, rank[:-k]], axis=0)
+        k *= 2
+    lane_live = rank[p - 1:p, :]  # (1, L) live rows per lane
+    spilled = jnp.maximum(lane_live - slots, 0)
+    n_spilled = jnp.sum(spilled).astype(jnp.uint32)
+
+    khi_i = khi.astype(jnp.int32)
+    klo_i = klo.astype(jnp.int32)
+    pck_i = packed.astype(jnp.int32)
+    sent_row = jnp.full((1, has.shape[1]), 0xFFFFFFFF, jnp.uint32)
+    out_khi, out_klo, out_pck = [], [], []
+    for s in range(slots):
+        onehot = has[s:, :] & (rank[s:, :] == s + 1)
+        sel = lambda v: jnp.sum(jnp.where(onehot, v[s:, :], 0), axis=0,
+                                keepdims=True).astype(jnp.uint32)
+        live = lane_live > s  # (1, L): slot s used in this lane
+        out_khi.append(jnp.where(live, sel(khi_i), sent_row))
+        out_klo.append(jnp.where(live, sel(klo_i), sent_row))
+        out_pck.append(jnp.where(live, sel(pck_i), sent_row))
+    cat = lambda xs: jnp.concatenate(xs, axis=0)
+    return cat(out_khi), cat(out_klo), cat(out_pck), n_spilled
+
+
 def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
-                     carry_ref, *, w: int, block_rows: int, data_rows: int):
+                     *refs, w: int, block_rows: int, data_rows: int,
+                     compact_slots: int = 0):
     """One grid step: emit pair-compacted (key_hi, key_lo, packed) planes.
 
     Logical output row t of block i describes byte-row ``m = i*block_rows +
@@ -121,6 +166,12 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
     accumulates the total emission count so callers get exact totals without
     another stream-sized pass.
     """
+    # Positional refs after the three planes + two scalars: the optional
+    # spill scalar (compact mode only), then the carry scratch.
+    if compact_slots:
+        spill_ref, carry_ref = refs
+    else:
+        spill_ref, (carry_ref,) = None, refs
     i = pl.program_id(0)
     tb = block_rows
 
@@ -132,6 +183,8 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
         carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
         over_ref[0, 0] = jnp.uint32(0)
         ntok_ref[0, 0] = jnp.uint32(0)
+        if spill_ref is not None:
+            spill_ref[0, 0] = jnp.uint32(0)
 
     # Widen bytes to int32 immediately: v5e Mosaic has no 8-bit vector
     # compares, and 32-bit lanes are the VPU-native layout anyway.
@@ -216,38 +269,60 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
         g = a.reshape(tb // 2, 2, LANES)
         return jnp.where(take_even, g[:, 0, :], g[:, 1, :])
 
-    even_has = (emit | overlong_here).reshape(tb // 2, 2, LANES)[:, 0, :]
-    khi_ref[:] = fold(khi, even_has)
-    klo_ref[:] = fold(klo, even_has)
-    packed_ref[:] = fold(packed, even_has)
+    live = (emit | overlong_here).reshape(tb // 2, 2, LANES)
+    even_has = live[:, 0, :]
+    khi_h = fold(khi, even_has)
+    klo_h = fold(klo, even_has)
+    packed_h = fold(packed, even_has)
+    if compact_slots:
+        has_h = live[:, 0, :] | live[:, 1, :]
+        khi_c, klo_c, pck_c, n_spill = _compact_planes(
+            khi_h, klo_h, packed_h, has_h, compact_slots)
+        khi_ref[:] = khi_c
+        klo_ref[:] = klo_c
+        packed_ref[:] = pck_c
+        spill_ref[0, 0] = spill_ref[0, 0] + n_spill
+    else:
+        khi_ref[:] = khi_h
+        klo_ref[:] = klo_h
+        packed_ref[:] = packed_h
 
 
 def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
-                 data_rows: int, interpret: bool):
+                 data_rows: int, interpret: bool, compact_slots: int = 0):
     """Run the kernel over the (rows, 128) column view (one trailing pad block).
 
-    Returns pair-compacted planes of rows//2 output rows: (key_hi, key_lo,
-    packed), plus the (overlong, token_count) SMEM scalars.
+    Returns pair-compacted planes of rows//2 output rows — or, with
+    ``compact_slots`` = S > 0, slot-compacted planes of rows/block_rows*S
+    output rows plus a spill count (live rows beyond any lane's budget) —
+    as (key_hi, key_lo, packed), plus the (overlong, token_count, spill)
+    scalars (spill is 0 on the pair path).
     """
     rows = cols_padded.shape[0]
     grid = rows // block_rows
     kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
-                             data_rows=data_rows)
-    out32 = jax.ShapeDtypeStruct((rows // 2, LANES), jnp.uint32)
+                             data_rows=data_rows, compact_slots=compact_slots)
+    out_rows = grid * compact_slots if compact_slots else rows // 2
+    block_out = compact_slots if compact_slots else block_rows // 2
+    out32 = jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
     scalar = jax.ShapeDtypeStruct((1, 1), jnp.uint32)
-    khi, klo, packed, over, ntok = pl.pallas_call(
+    n_scalars = 3 if compact_slots else 2
+    outs = pl.pallas_call(
         kern,
         grid=(grid,),
         in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
-        out_shape=[out32, out32, out32, scalar, scalar],
-        out_specs=[pl.BlockSpec((block_rows // 2, LANES), lambda i: (i, 0),
+        out_shape=[out32, out32, out32] + [scalar] * n_scalars,
+        out_specs=[pl.BlockSpec((block_out, LANES), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)] * 3
-        + [pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)] * 2,
+        + [pl.BlockSpec((1, 1), lambda i: (0, 0),
+                        memory_space=pltpu.SMEM)] * n_scalars,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
         interpret=interpret,
     )(cols_padded)
-    return khi, klo, packed, over[0, 0], ntok[0, 0]
+    khi, klo, packed, over, ntok = outs[:5]
+    spill = outs[5][0, 0] if compact_slots else jnp.uint32(0)
+    return khi, klo, packed, over[0, 0], ntok[0, 0], spill
 
 
 def _seam_pass(data: jax.Array, seg_len: int, w: int,
@@ -319,7 +394,7 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
                    max_token_bytes: int = DEFAULT_MAX_TOKEN,
                    block_rows: int | None = None,
                    interpret: bool | None = None
-                   ) -> tuple[TokenStream, TokenStream, jax.Array]:
+                   ) -> tuple[PackedTokenStream, TokenStream, jax.Array]:
     """Pallas-backed tokenize returning ``(col_stream, seam_stream, overlong)``
     — the bulk column-pass emissions and the tiny (~129*(2W+2) entries) seam
     fix-up emissions as *separate* streams.
@@ -340,6 +415,42 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
 
     Requirements: ``len(data) % 128 == 0`` and at least one full block.
     """
+    col, seam, overlong, _ = _tokenize_split_impl(
+        data, base_offset, max_token_bytes, block_rows, interpret, 0)
+    return col, seam, overlong
+
+
+def tokenize_split_compact(data: jax.Array, compact_slots: int,
+                           base_offset: jax.Array | int = 0,
+                           max_token_bytes: int = DEFAULT_MAX_TOKEN,
+                           block_rows: int | None = None,
+                           interpret: bool | None = None
+                           ) -> tuple[PackedTokenStream, TokenStream,
+                                      jax.Array, jax.Array]:
+    """:func:`tokenize_split` with slot-compacted column planes: returns
+    ``(col_stream, seam_stream, overlong, spill)``.
+
+    The column planes hold ``compact_slots`` output rows per ``block_rows``
+    byte rows (vs the pair path's ``block_rows/2``) — the downstream sort's
+    input shrinks by the same ratio, which is where the chunk budget goes
+    (BENCHMARKS.md op profile).  ``spill`` (uint32) counts live rows beyond
+    any (block, lane) window's budget: when it is nonzero the compact
+    planes are INCOMPLETE and the caller must discard them and re-run the
+    full-resolution path (``models/wordcount._map_stream`` wraps exactly
+    that in a ``lax.cond``).  Measured window densities (tools/density.py):
+    the default 88 slots per 256-byte window never spills on either bench
+    corpus (observed max 77, Zipf) — the fallback is for adversarial text
+    (e.g. runs of single-letter tokens at density > 0.34), which stays
+    exact at ~2x the chunk cost.
+    """
+    if compact_slots <= 0:
+        raise ValueError(f"compact_slots must be > 0, got {compact_slots}")
+    return _tokenize_split_impl(data, base_offset, max_token_bytes,
+                                block_rows, interpret, compact_slots)
+
+
+def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
+                         interpret, compact_slots: int):
     if interpret is None:
         # Mosaic only targets TPU; elsewhere (CPU tests, debugging) the
         # interpreter executes the same kernel semantics.
@@ -370,6 +481,12 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
         raise ValueError(f"block_rows {block_rows} must be >= max_token_bytes+2")
     if block_rows % 2:
         raise ValueError(f"block_rows must be even, got {block_rows}")
+    if compact_slots and not 8 <= compact_slots <= block_rows // 2:
+        raise ValueError(f"compact_slots {compact_slots} must be in "
+                         f"[8, block_rows/2={block_rows // 2}]")
+    if compact_slots % 8:
+        raise ValueError(f"compact_slots must be a multiple of 8 (sublane "
+                         f"alignment), got {compact_slots}")
     if seg_len < 2 * w + 2:
         raise ValueError(
             f"input of {n} bytes gives lane segments of {seg_len} < 2W+2="
@@ -383,8 +500,9 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
     cols_padded = jnp.concatenate(
         [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
 
-    khi, klo, packed, over_cols, n_tokens = _column_pass(
-        cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret)
+    khi, klo, packed, over_cols, n_tokens, spill = _column_pass(
+        cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
+        compact_slots=compact_slots)
 
     # The kernel already pair-compacted and packed (start << 6 | len) in
     # VMEM (see _tokenize_kernel); reconstruct the TokenStream view lazily —
@@ -412,7 +530,7 @@ def tokenize_split(data: jax.Array, base_offset: jax.Array | int = 0,
         total=n_tokens)
 
     seam_stream, over_seams = _seam_pass(data, seg_len, w, base_offset)
-    return col_stream, seam_stream, over_cols + over_seams
+    return col_stream, seam_stream, over_cols + over_seams, spill
 
 
 def concat_streams(col: PackedTokenStream, seam: TokenStream) -> PackedTokenStream:

@@ -103,11 +103,18 @@ def main() -> int:
                  {**env, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
                 ("bench-zipf-merge8", [sys.executable, "bench.py"],
                  {**env, "BENCH_MERGE_EVERY": "8"}),
+                ("bench-zipf-compact88", [sys.executable, "bench.py"],
+                 {**env, "BENCH_COMPACT_SLOTS": "88"}),
+                ("bench-zipf-stacked", [sys.executable, "bench.py"],
+                 {**env, "BENCH_COMPACT_SLOTS": "88", "BENCH_MERGE_EVERY": "8",
+                  "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
                 ("opshare-sort3", [sys.executable, "tools/opshare.py"], env),
                 ("opshare-segmin", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_SORT_MODE": "segmin"}),
                 ("opshare-merge8", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_MERGE_EVERY": "8"}),
+                ("opshare-compact88", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_COMPACT_SLOTS": "88"}),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}

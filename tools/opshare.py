@@ -73,7 +73,8 @@ def main() -> int:
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("OPSHARE_SORT_MODE", "sort3"),
-                 merge_every=int(os.environ.get("OPSHARE_MERGE_EVERY", "1")))
+                 merge_every=int(os.environ.get("OPSHARE_MERGE_EVERY", "1")),
+                 compact_slots=int(os.environ.get("OPSHARE_COMPACT_SLOTS", "0")))
     print(f"backend={jax.default_backend()} chunk={chunk_mb}MB "
           f"sort_mode={cfg.sort_mode} merge_every={cfg.merge_every} "
           f"steps={steps}", file=sys.stderr)
@@ -142,6 +143,7 @@ def main() -> int:
         "backend": jax.default_backend(),
         "chunk_mb": chunk_mb, "steps": steps,
         "sort_mode": cfg.sort_mode, "merge_every": cfg.merge_every,
+        "compact_slots": cfg.compact_slots,
         "total_device_us": round(total, 0),
         "us_per_chunk": round(total / steps, 0),
         "sort_share": round(fam_us.get("sort", 0.0) / total, 4),
