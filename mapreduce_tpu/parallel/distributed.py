@@ -22,14 +22,14 @@ Two multi-host modes::
     lo, hi = dist.align_range_to_separator(path, lo, hi)
     rr = executor.run_job(job, path, byte_range=(lo, hi))   # local mesh
 
-    # (b) one global SPMD program: a global mesh plus per-host staging.
-    #     Each host reads only its own shard rows and places them with
-    #     device_put_local (make_array_from_process_local_data); the
-    #     resulting global arrays feed Engine.step/step_many directly
-    #     (device_put on an already-sharded array is a no-op), and the
-    #     engine's collective finish replicates the result everywhere.
-    #     run_job's convenience staging is host-local numpy and therefore
-    #     single-host; mode (b) drives the Engine, not run_job.
+    # (b) one global SPMD program: a global mesh plus per-host staging —
+    #     run from ONE entry point, executor.run_job_global (every process
+    #     calls it with the same arguments; each stages only its own
+    #     host_shards rows via device_put_local, the collective finish
+    #     replicates the result, checkpoints are coordinator-written and
+    #     resumable — tested end-to-end with a real 2-process gloo run in
+    #     tests/test_multihost.py, crash + resume included).
+    rr = executor.run_job_global(job, path, config=cfg, checkpoint_path=ck)
 
 ``initialize`` wraps :func:`jax.distributed.initialize`, which reads the
 cluster-environment variables (coordinator address, process count/index) that

@@ -32,6 +32,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
@@ -147,6 +148,7 @@ class Engine:
         self._step_fn = None
         self._step_many_fns: dict[tuple[int, int], Any] = {}  # (K, repeats)
         self._finish_fn = None
+        self._rep_fn = None
 
     def _device_index(self):
         """Linear index of this shard across all sharded axes (row-major)."""
@@ -169,6 +171,31 @@ class Engine:
         stacked = jax.tree.map(
             lambda x: jnp.broadcast_to(x[None], (self.n_devices,) + x.shape), one)
         return jax.device_put(stacked, self._sharded)
+
+    def init_states_global(self) -> Any:
+        """Sharded initial state for multi-controller SPMD (a mesh spanning
+        several processes): no process can ``device_put`` to another
+        process's devices, so the global program itself materializes the
+        state and ``out_shardings`` places it.  Identical result to
+        :meth:`init_states` on a single-process mesh."""
+        job, n = self.job, self.n_devices
+
+        def init():
+            one = job.init_state()
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), one)
+
+        return jax.jit(init, out_shardings=self._sharded)()
+
+    def replicate_to_host(self, state: Any) -> Any:
+        """Fetch a (possibly non-addressable) sharded state as host numpy:
+        one jitted identity with replicated out_shardings (an all_gather
+        over the mesh) makes every shard addressable on every process —
+        the multi-host checkpoint fetch.  Costs one collective round."""
+        if self._rep_fn is None:
+            self._rep_fn = jax.jit(lambda s: s,
+                                   out_shardings=self._replicated)
+        return jax.tree.map(np.asarray, self._rep_fn(state))
 
     # -- compiled programs ---------------------------------------------------
 

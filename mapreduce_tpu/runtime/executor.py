@@ -49,6 +49,168 @@ class RunResult:
     bases: np.ndarray  # int64[steps, D] row base offsets (string recovery)
 
 
+@dataclasses.dataclass
+class _StreamHooks:
+    """The strategy seams between the host-local (:func:`run_job`) and
+    global-SPMD (:func:`run_job_global`) drivers.  Everything else about
+    streaming — superstep grouping, checkpoint-boundary splitting, file-
+    boundary hooks, the retry loop, progress/checkpoint cadence — is ONE
+    shared loop (:func:`_drive_stream`), so a fix to that machinery lands
+    in both entry points by construction."""
+
+    stage_single: Any  # Batch -> engine.step chunks argument
+    stage_group: Any  # list[Batch] -> engine.step_many stacked argument
+    snapshot: Any  # device state -> host pytree (checkpoint fetch / retry)
+    restage: Any  # host pytree -> sharded device state (retry; None = n/a)
+    write_gate: Any  # () -> bool: this process writes checkpoint files
+    retry: int = 0
+
+
+def _drive_stream(engine, job, config: Config, path, state,
+                  hooks: _StreamHooks, *, start_step: int, start_offset: int,
+                  end_offset, bases_list: list, checkpoint_path,
+                  checkpoint_every: int, fingerprint, resumed_file,
+                  logger, progress_every: int):
+    """The shared streaming loop: reader -> prefetch -> superstep groups ->
+    engine dispatch, with checkpoint cadence and file-boundary hooks.
+    Returns ``(state, bytes_done, step_index)``; ``bytes_done`` is the
+    absolute stream cursor (starts at ``start_offset``)."""
+    bytes_done = int(start_offset)
+    step_index = start_step
+    last_ckpt = start_step // checkpoint_every if checkpoint_every else 0
+    k = config.superstep
+    pending: list = []
+
+    def dispatch(state, group):
+        if len(group) == 1:
+            return engine.step(state, hooks.stage_single(group[0]),
+                               group[0].step)
+        return engine.step_many(state, hooks.stage_group(group),
+                                group[0].step)
+
+    def split_at_checkpoints(group):
+        """Cut a superstep group at checkpoint boundaries, so resume
+        granularity is governed by ``checkpoint_every`` even when it is
+        finer than the superstep: a crash then replays at most
+        ``checkpoint_every`` chunks per device, not a whole superstep
+        (set ``checkpoint_every >= superstep`` to keep the full dispatch
+        amortization)."""
+        if not (checkpoint_every and checkpoint_path):
+            return [group]
+        subs, cur = [], []
+        for b in group:
+            cur.append(b)
+            if (b.step + 1) % checkpoint_every == 0:
+                subs.append(cur)
+                cur = []
+        if cur:
+            subs.append(cur)
+        return subs
+
+    def flush(state, group):
+        """Dispatch a group of consecutive batches (one superstep, split at
+        any interior checkpoint boundaries)."""
+        for sub in split_at_checkpoints(group):
+            state = flush_one(state, sub)
+        return state
+
+    def flush_one(state, group):
+        """Dispatch one group of consecutive batches as a single program."""
+        nonlocal bytes_done, step_index, last_ckpt
+        # The dispatch donates `state`; a known-good host snapshot (taken
+        # BEFORE donation) is what makes a retry possible at all.
+        snapshot = hooks.snapshot(state) if hooks.retry > 0 else None
+        for attempt in range(hooks.retry + 1):
+            try:
+                state = dispatch(state, group)
+                if hooks.retry > 0:
+                    # Device failures surface asynchronously at the next
+                    # blocking fetch — which without this sync would be the
+                    # NEXT group's snapshot, outside this try: the failure
+                    # would skip retry entirely and be blamed on the wrong
+                    # step.  Blocking here attributes it to the dispatch
+                    # that caused it.  (retry=0 keeps the async pipeline:
+                    # there is nothing to attribute a failure to.)
+                    jax.block_until_ready(state)
+                break
+            except Exception:
+                if attempt >= hooks.retry:
+                    # Failure detection (SURVEY §5): out of retries (or none
+                    # requested).  Surface loudly with the resume cursor;
+                    # checkpoint/resume is the recovery path.
+                    log_event(logger, "step failed", step=group[0].step,
+                              offset=bytes_done,
+                              resume_hint=checkpoint_path
+                              or "enable checkpointing to resume")
+                    raise
+                # Transient-failure recovery: rebuild a fresh sharded state
+                # from the snapshot and re-dispatch the same host batches.
+                log_event(logger, "step failed; retrying",
+                          step=group[0].step, attempt=attempt + 1)
+                state = hooks.restage(snapshot)
+        for b in group:
+            bases_list.append(b.base_offsets)
+            bytes_done += int(b.lengths.sum())
+        step_index = group[-1].step + 1
+        if progress_every and step_index % progress_every < len(group):
+            log_event(logger, "progress", step=step_index, bytes=bytes_done)
+        if (checkpoint_every and checkpoint_path
+                and step_index // checkpoint_every > last_ckpt):
+            last_ckpt = step_index // checkpoint_every
+            # Synchronize, then snapshot the state and ingest cursor.  The
+            # snapshot format holds ANY job state pytree (tables, sketched
+            # states, grep scalars alike).  Multi-host: every process pays
+            # the fetch (it is a collective there), only the gate-holder
+            # touches the filesystem.
+            state_host = hooks.snapshot(state)
+            if hooks.write_gate():
+                # file_index makes the snapshot boundary-aware: resuming a
+                # checkpoint that ends a corpus member must still fire the
+                # job's on_input_boundary hook on the next member's first
+                # batch (the carry reset happens AFTER this save in the
+                # stream loop).
+                ckpt_mod.save(checkpoint_path, state_host, step_index,
+                              bytes_done, np.stack(bases_list),
+                              fingerprint=fingerprint,
+                              file_index=group[-1].file_index)
+            log_event(logger, "checkpoint", step=step_index,
+                      path=checkpoint_path, writer=hooks.write_gate())
+        return state
+
+    # Jobs with cross-row sequential state (grep's line carry) reset it at
+    # file boundaries — files are independent corpora.  Optional, duck-typed
+    # like the other hooks; transitions are rare (once per corpus member),
+    # so the early superstep flush they force costs nothing measurable.
+    boundary_hook = getattr(job, "on_input_boundary", None)
+    # Resume restores which corpus member the snapshot's last batch came
+    # from, so a snapshot saved at a file seam still triggers the boundary
+    # hook on the next file's first batch (advisor round 2: last_file=None
+    # after resume silently skipped the reset and leaked grep's line carry).
+    last_file: Optional[int] = resumed_file
+    # Prefetch: host-side chunking of step N+1 overlaps device compute of
+    # step N (the double-buffering of SURVEY §7 step 4).
+    for batch in reader_mod.prefetch(
+            reader_mod.iter_batches_multi(path, engine.n_devices,
+                                          config.chunk_bytes,
+                                          start_offset=start_offset,
+                                          start_step=start_step,
+                                          end_offset=end_offset)):
+        if (boundary_hook is not None and last_file is not None
+                and batch.file_index != last_file):
+            if pending:
+                state = flush(state, pending)
+                pending = []
+            state = boundary_hook(state)
+        last_file = batch.file_index
+        pending.append(batch)
+        if len(pending) == k:
+            state = flush(state, pending)
+            pending = []
+    for batch in pending:  # remainder: single steps (no extra jit cache keys)
+        state = flush(state, [batch])
+    return state, bytes_done, step_index
+
+
 def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
@@ -73,9 +235,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     (``table_ops.merge``) across hosts.  Note this per-host-driven mode uses
     a host-LOCAL mesh: run_job stages plain numpy batches, so a mesh spanning
     non-addressable devices is not supported here — for one global SPMD
-    program over all hosts, stage shards with
-    ``distributed.device_put_local`` and drive ``Engine.step`` directly
-    (see :mod:`mapreduce_tpu.parallel.distributed`).
+    program over all hosts use :func:`run_job_global`.
     """
     if retry < 0:
         raise ValueError(f"retry must be >= 0, got {retry}")
@@ -116,133 +276,21 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         state = engine.init_states()
         resumed_file = None
 
-    bytes_done = int(start_offset)
-    step_index = start_step
-    last_ckpt = start_step // checkpoint_every if checkpoint_every else 0
-    k = config.superstep
-    pending: list = []
-
-    def dispatch(state, group):
-        if len(group) == 1:
-            return engine.step(state, group[0].data, group[0].step)
-        stacked = np.stack([b.data for b in group], axis=1)
-        return engine.step_many(state, stacked, group[0].step)
-
-    def split_at_checkpoints(group):
-        """Cut a superstep group at checkpoint boundaries, so resume
-        granularity is governed by ``checkpoint_every`` even when it is
-        finer than the superstep: a crash then replays at most
-        ``checkpoint_every`` chunks per device, not a whole superstep
-        (set ``checkpoint_every >= superstep`` to keep the full dispatch
-        amortization)."""
-        if not (checkpoint_every and checkpoint_path):
-            return [group]
-        subs, cur = [], []
-        for b in group:
-            cur.append(b)
-            if (b.step + 1) % checkpoint_every == 0:
-                subs.append(cur)
-                cur = []
-        if cur:
-            subs.append(cur)
-        return subs
-
-    def flush(state, group):
-        """Dispatch a group of consecutive batches (one superstep, split at
-        any interior checkpoint boundaries)."""
-        for sub in split_at_checkpoints(group):
-            state = flush_one(state, sub)
-        return state
-
-    def flush_one(state, group):
-        """Dispatch one group of consecutive batches as a single program."""
-        nonlocal bytes_done, step_index, last_ckpt
-        # The dispatch donates `state`; a known-good host snapshot (taken
-        # BEFORE donation) is what makes a retry possible at all.
-        snapshot = jax.tree.map(np.asarray, state) if retry > 0 else None
-        for attempt in range(retry + 1):
-            try:
-                state = dispatch(state, group)
-                if retry > 0:
-                    # Device failures surface asynchronously at the next
-                    # blocking fetch — which without this sync would be the
-                    # NEXT group's snapshot, outside this try: the failure
-                    # would skip retry entirely and be blamed on the wrong
-                    # step.  Blocking here attributes it to the dispatch
-                    # that caused it.  (retry=0 keeps the async pipeline:
-                    # there is nothing to attribute a failure to.)
-                    jax.block_until_ready(state)
-                break
-            except Exception:
-                if attempt >= retry:
-                    # Failure detection (SURVEY §5): out of retries (or none
-                    # requested).  Surface loudly with the resume cursor;
-                    # checkpoint/resume is the recovery path.
-                    log_event(logger, "step failed", step=group[0].step,
-                              offset=bytes_done,
-                              resume_hint=checkpoint_path
-                              or "enable checkpointing to resume")
-                    raise
-                # Transient-failure recovery: rebuild a fresh sharded state
-                # from the snapshot and re-dispatch the same host batches.
-                log_event(logger, "step failed; retrying",
-                          step=group[0].step, attempt=attempt + 1)
-                state = jax.device_put(snapshot, engine._sharded)
-        for b in group:
-            bases_list.append(b.base_offsets)
-            bytes_done += int(b.lengths.sum())
-        step_index = group[-1].step + 1
-        if progress_every and step_index % progress_every < len(group):
-            log_event(logger, "progress", step=step_index, bytes=bytes_done)
-        if (checkpoint_every and checkpoint_path
-                and step_index // checkpoint_every > last_ckpt):
-            last_ckpt = step_index // checkpoint_every
-            # Synchronize, then snapshot the state and ingest cursor.  The
-            # snapshot format holds ANY job state pytree (tables, sketched
-            # states, grep scalars alike).
-            state_host = jax.tree.map(np.asarray, state)
-            # file_index makes the snapshot boundary-aware: resuming a
-            # checkpoint that ends a corpus member must still fire the
-            # job's on_input_boundary hook on the next member's first batch
-            # (the carry reset happens AFTER this save in the stream loop).
-            ckpt_mod.save(checkpoint_path, state_host, step_index,
-                          bytes_done, np.stack(bases_list),
-                          fingerprint=fingerprint,
-                          file_index=group[-1].file_index)
-            log_event(logger, "checkpoint", step=step_index, path=checkpoint_path)
-        return state
-
+    hooks = _StreamHooks(
+        stage_single=lambda b: b.data,
+        stage_group=lambda g: np.stack([b.data for b in g], axis=1),
+        snapshot=lambda s: jax.tree.map(np.asarray, s),
+        restage=lambda s_np: jax.device_put(s_np, engine._sharded),
+        write_gate=lambda: True,
+        retry=retry)
     timer.start("stream")
-    # Jobs with cross-row sequential state (grep's line carry) reset it at
-    # file boundaries — files are independent corpora.  Optional, duck-typed
-    # like the other hooks; transitions are rare (once per corpus member),
-    # so the early superstep flush they force costs nothing measurable.
-    boundary_hook = getattr(job, "on_input_boundary", None)
-    # Resume restores which corpus member the snapshot's last batch came
-    # from, so a snapshot saved at a file seam still triggers the boundary
-    # hook on the next file's first batch (advisor round 2: last_file=None
-    # after resume silently skipped the reset and leaked grep's line carry).
-    last_file: Optional[int] = resumed_file
-    # Prefetch: host-side chunking of step N+1 overlaps device compute of
-    # step N (the double-buffering of SURVEY §7 step 4).
-    for batch in reader_mod.prefetch(
-            reader_mod.iter_batches_multi(path, n_dev, config.chunk_bytes,
-                                    start_offset=start_offset,
-                                    start_step=start_step,
-                                    end_offset=range_hi)):
-        if (boundary_hook is not None and last_file is not None
-                and batch.file_index != last_file):
-            if pending:
-                state = flush(state, pending)
-                pending = []
-            state = boundary_hook(state)
-        last_file = batch.file_index
-        pending.append(batch)
-        if len(pending) == k:
-            state = flush(state, pending)
-            pending = []
-    for batch in pending:  # remainder: single steps (no extra jit cache keys)
-        state = flush(state, [batch])
+    state, bytes_done, _ = _drive_stream(
+        engine, job, config, path, state, hooks,
+        start_step=start_step, start_offset=start_offset,
+        end_offset=range_hi, bases_list=bases_list,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint, resumed_file=resumed_file,
+        logger=logger, progress_every=progress_every)
     timer.stop("stream")
 
     timer.start("reduce")
@@ -259,6 +307,120 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     m = metrics_mod.RunMetrics(bytes_processed=bytes_done - range_lo, words_counted=words,
                                elapsed_s=total_s, phases=dict(timer.phases))
     log_event(logger, "run complete", **m.as_dict())
+    bases = np.stack(bases_list) if bases_list else np.zeros((0, n_dev), np.int64)
+    return RunResult(value=value, metrics=m, bases=bases)
+
+
+def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
+                   mesh=None, merge_strategy: str = "tree",
+                   checkpoint_path: Optional[str] = None,
+                   checkpoint_every: int = 0,
+                   logger=None, progress_every: int = 50) -> RunResult:
+    """Multi-host mode (b) as one entry point: ONE global SPMD program over
+    every chip of every process (VERDICT r3 #5; the 100 GB / v5e-256
+    BASELINE config runs through this).
+
+    Every process calls this with the same arguments after
+    :func:`...parallel.distributed.initialize`.  Per process:
+
+      * the mesh spans ALL processes' devices
+        (:func:`...parallel.distributed.global_data_mesh` by default);
+      * the reader runs identically everywhere (same deterministic chunk
+        geometry — cut offsets must agree across processes), but each
+        process STAGES only its own contiguous block of shard rows
+        (``host_shards``) via ``device_put_local``, so no process ships
+        another's data over DCN;
+      * the engine step is the same jitted SPMD program on every process
+        (multi-controller SPMD: identical programs, local data);
+      * the collective ``finish`` replicates the merged result to every
+        process — the returned ``RunResult`` is identical everywhere;
+        report/print on :func:`...parallel.distributed.is_coordinator`.
+
+    Checkpointing: the sharded state is fetched with one all-gather round
+    (:meth:`Engine.replicate_to_host`) and ONLY the coordinator writes the
+    snapshot (``checkpoint_path`` should be on storage the coordinator owns;
+    resume requires every process to read it — shared filesystem, or
+    distribute the file before relaunch).  Resume re-stages each process's
+    own shard rows from the snapshot.  Step retry is not offered here: a
+    failed collective leaves peer processes blocked mid-program, so the
+    recovery path for global runs IS checkpoint/resume (SURVEY §5 failure
+    detection: the jax.distributed heartbeat surfaces dead peers).
+
+    Differences from :func:`run_job`: no ``byte_range`` (the global program
+    consumes the whole corpus; per-host byte ranges are mode (a)), no
+    ``retry``, and single-buffer convenience staging is replaced by
+    ``device_put_local``.
+    """
+    from mapreduce_tpu.parallel import distributed as dist
+
+    logger = logger or get_logger()
+    mesh = mesh if mesh is not None else dist.global_data_mesh()
+    axes = tuple(mesh.axis_names)
+    n_dev = mesh.size
+    engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
+                    merge_strategy=merge_strategy)
+    mine = np.asarray(dist.host_shards(n_dev), dtype=np.int64)
+
+    timer = metrics_mod.PhaseTimer()
+    timer.start("total")
+
+    start_step, start_offset = 0, 0
+    bases_list: list[np.ndarray] = []
+    fingerprint = ckpt_mod.run_fingerprint(
+        path, n_dev, config.chunk_bytes, backend=config.resolved_backend(),
+        pallas_max_token=config.pallas_max_token, byte_range=None,
+        job_identity=job.identity()) if checkpoint_path else None
+
+    def stage(host_rows: np.ndarray):
+        """This process's rows -> one globally-sharded array."""
+        return dist.device_put_local(host_rows, engine.sharding)
+
+    if checkpoint_path and ckpt_mod.exists(checkpoint_path):
+        template = jax.eval_shape(engine.init_states_global)
+        state_np, start_step, start_offset, bases_arr, resumed_file = \
+            ckpt_mod.load(checkpoint_path, template=template,
+                          expect_fingerprint=fingerprint)
+        state = jax.tree.map(lambda x: stage(np.asarray(x)[mine]), state_np)
+        bases_list = list(bases_arr)
+        log_event(logger, "resumed from checkpoint (global)",
+                  step=start_step, offset=start_offset)
+    else:
+        state = engine.init_states_global()
+        resumed_file = None
+
+    hooks = _StreamHooks(
+        stage_single=lambda b: stage(b.data[mine]),
+        stage_group=lambda g: stage(np.stack([b.data[mine] for b in g],
+                                             axis=1)),
+        # The checkpoint fetch is a collective (one all-gather round makes
+        # the sharded state addressable everywhere); only the coordinator
+        # touches the filesystem.  No retry (see docstring).
+        snapshot=engine.replicate_to_host,
+        restage=None,
+        write_gate=dist.is_coordinator,
+        retry=0)
+    timer.start("stream")
+    state, bytes_done, _ = _drive_stream(
+        engine, job, config, path, state, hooks,
+        start_step=start_step, start_offset=start_offset,
+        end_offset=None, bases_list=bases_list,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint, resumed_file=resumed_file,
+        logger=logger, progress_every=progress_every)
+    timer.stop("stream")
+
+    timer.start("reduce")
+    value = engine.finish(state)  # replicated: addressable on every process
+    value = jax.tree.map(np.asarray, value)
+    timer.stop("reduce")
+    total_s = timer.stop("total")
+
+    result_table = value.table if isinstance(value, SketchedState) else value
+    words = int(result_table.total_count()) \
+        if isinstance(result_table, table_ops.CountTable) else 0
+    m = metrics_mod.RunMetrics(bytes_processed=bytes_done, words_counted=words,
+                               elapsed_s=total_s, phases=dict(timer.phases))
+    log_event(logger, "global run complete", **m.as_dict())
     bases = np.stack(bases_list) if bases_list else np.zeros((0, n_dev), np.int64)
     return RunResult(value=value, metrics=m, bases=bases)
 

