@@ -157,6 +157,34 @@ def make_natural_corpus(n_bytes: int, seed: int = 11) -> bytes:
     return b"".join(parts)[:n_bytes].rsplit(b" ", 1)[0] + b"\n"
 
 
+def make_webby_corpus(n_bytes: int, seed: int = 23) -> bytes:
+    """Natural-text proxy with an enwik-like long-token tail.
+
+    enwik8 (wikipedia XML) carries URLs, wiki-link paths and attribute blobs
+    far beyond the pallas kernel's W=32 window; WET Common-Crawl text adds
+    base64-ish junk.  ~0.3% of words here become such tokens (enwik8
+    ballpark: 0.1-0.5% of whitespace-delimited tokens exceed 32 bytes),
+    lengths log-uniform in [33, 300] — the corpus that exercises the
+    overlong-rescue path (ops/rescue.py) under benchmark load, where the
+    other generators never fire its cond.
+    """
+    rng = np.random.default_rng(seed)
+    words = make_natural_corpus(n_bytes, seed=seed).split(b" ")
+    alpha = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz0123456789./_-=&?",
+                          np.uint8)
+    # Splice URLs at ~0.3% of sites: touch only the chosen sites (one draw
+    # of all URL bytes up front), not every word — corpus generation runs
+    # inside the scarce live-relay bench window.
+    sites = np.flatnonzero(rng.random(len(words)) < 0.003)
+    lengths = np.exp(rng.uniform(np.log(33), np.log(300),
+                                 size=len(sites))).astype(np.int64)
+    blob = alpha[rng.integers(0, len(alpha), int(lengths.sum()))].tobytes()
+    ends = np.cumsum(lengths)
+    for i, site in enumerate(sites):
+        words[site] = b"http://" + blob[ends[i] - lengths[i]:ends[i]]
+    return b" ".join(words)[:n_bytes]
+
+
 def cpu_baseline_gbps(data: bytes, repeats: int = 1) -> float:
     from collections import Counter
 
@@ -175,19 +203,58 @@ def _log(msg: str, t0: float) -> None:
     print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}", file=sys.stderr)
 
 
+# Headline result recorded the moment the device-resident window completes.
+# The round-3 failure mode this kills: the timed window SUCCEEDED at +252s,
+# then the optional streamed phase hung past the watchdog, and the recorded
+# round number was 0.0 — a real measurement thrown away.  The watchdog now
+# emits this partial record (and the main flow writes BENCH_LAST_GOOD.json
+# the moment it exists), so optional post-phases can only ever ADD data.
+_PARTIAL_RESULT: dict | None = None
+_WATCHDOG_DEADLINE: list = []  # single mutable slot: absolute deadline
+
+
+def _write_last_good(result: dict) -> None:
+    if result.get("backend") == "cpu":
+        # A CPU smoke run must not clobber the TPU evidence a wedged later
+        # round needs to fall back on.
+        return
+    try:
+        with open(LAST_GOOD_PATH, "w") as f:
+            json.dump({**result, "recorded_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+            f.write("\n")
+    except OSError:
+        pass  # read-only checkout: the caller already has the line
+
+
 def _arm_watchdog(seconds: int, wall0: float) -> None:
-    """Fail fast with an explicit JSON error line if the device hangs.
+    """Fail fast with an explicit JSON line if the device hangs.
 
     The bench chip sits behind a shared relay that can wedge indefinitely
     (a killed client leaving a claimed session blocks every subsequent
     device op, including jax.devices()).  A hung device_put is not
-    interruptible from Python, so a daemon timer hard-exits with a
-    machine-readable failure instead of silently consuming the caller's
-    entire time budget.  BENCH_WATCHDOG_S overrides (0 disables).
+    interruptible from Python, so a daemon timer hard-exits — with the
+    PARTIAL headline result if the timed window already completed (exit 0),
+    else a machine-readable failure (exit 3).  Re-arm by appending a new
+    absolute deadline to ``_WATCHDOG_DEADLINE`` (each optional post-phase
+    gets its own budget).  BENCH_WATCHDOG_S overrides (0 disables).
     """
     import threading
 
+    _WATCHDOG_DEADLINE.append(time.monotonic() + seconds)
+
     def fire():
+        now = time.monotonic()
+        if now < _WATCHDOG_DEADLINE[-1] - 0.5:
+            t = threading.Timer(_WATCHDOG_DEADLINE[-1] - now, fire)
+            t.daemon = True
+            t.start()
+            return
+        if _PARTIAL_RESULT is not None:
+            _log("WATCHDOG: post-window phase hung — emitting the partial "
+                 "headline result instead of discarding it", wall0)
+            print(json.dumps(_PARTIAL_RESULT), flush=True)
+            os._exit(0)
         _log(f"WATCHDOG: no completion after {seconds}s — device tunnel "
              "wedged or unreachable; aborting", wall0)
         _fail_json(f"device unreachable: bench exceeded {seconds}s "
@@ -198,6 +265,13 @@ def _arm_watchdog(seconds: int, wall0: float) -> None:
     t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
+
+
+def _rearm_watchdog(seconds: int, wall0: float) -> None:
+    """Give the next phase its own budget (the timer chain re-checks)."""
+    if _WATCHDOG_DEADLINE:
+        _WATCHDOG_DEADLINE[-1] = max(_WATCHDOG_DEADLINE[-1],
+                                     time.monotonic() + seconds)
 
 
 def main() -> int:
@@ -252,10 +326,19 @@ def main() -> int:
     elif corpus_kind == "natural":
         corpus = make_natural_corpus(mb << 20)
         corpus_name = "synthetic-natural"
+    elif corpus_kind == "webby":
+        corpus = make_webby_corpus(mb << 20)
+        corpus_name = "synthetic-webby"
     else:
         corpus = make_zipf_corpus(mb << 20)
         corpus_name = "synthetic-zipf"
     _log(f"corpus ready: {len(corpus) >> 20} MB ({corpus_name})", wall0)
+
+    # CPU baseline BEFORE any device work: it is pure host numpy and it
+    # makes vs_baseline available the moment the timed window lands (the
+    # headline record must never wait on an optional post-phase).
+    base = cpu_baseline_gbps(corpus[: base_mb << 20], repeats=3)
+    _log(f"cpu baseline: {base:.4f} GB/s over {base_mb} MB", wall0)
 
     import jax
 
@@ -281,7 +364,9 @@ def main() -> int:
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("BENCH_SORT_MODE", "sort3"),
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
-                 compact_slots=int(os.environ.get("BENCH_COMPACT_SLOTS", "0")))
+                 compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
+                                if "BENCH_COMPACT_SLOTS" in os.environ
+                                else None))
     mesh = data_mesh()
     n_dev = mesh.devices.size
     engine = Engine(WordCountJob(cfg), mesh)
@@ -341,6 +426,27 @@ def main() -> int:
         gbps = steady_bytes / 1e9 / dt
         words_per_s = total_words * (steady_bytes / processed_bytes) / dt
 
+        # The headline is now a fact: record it durably BEFORE the optional
+        # streamed phase (whose fresh compiles through a slow tunnel are
+        # exactly what blew the round-3 watchdog and zeroed the round).
+        global _PARTIAL_RESULT
+        _PARTIAL_RESULT = {
+            "metric": "zipf_wordcount_device_throughput",
+            "input": corpus_name,
+            "h2d_gbps": round(h2d_gbps, 4),
+            "value": round(gbps, 4),
+            "unit": "GB/s",
+            "vs_baseline": round(gbps / base, 3) if base else 0.0,
+            "corpus_mb": round(group_bytes / (1 << 20), 1),
+            "devices": n_dev,
+            "backend": jax.devices()[0].platform,
+            "total_words": total_words,
+            "cpu_baseline_gbps": round(base, 4),
+            "words_per_s": round(words_per_s, 0),
+        }
+        _write_last_good(_PARTIAL_RESULT)
+        _rearm_watchdog(watchdog_s or 480, wall0)
+
         # End-to-end STREAMED ingest (VERDICT r3 #7): reader + prefetch +
         # H2D + compute + collective finish through the executor's run_job
         # path — the BASELINE.md "GB/s ingest" metric proper, where the
@@ -349,65 +455,44 @@ def main() -> int:
         # the same way production runs do.  BENCH_STREAMED=0 skips.
         streamed_gbps = None
         if os.environ.get("BENCH_STREAMED", "1") != "0":
-            import dataclasses
+            try:
+                import dataclasses
 
-            from mapreduce_tpu.runtime import executor
+                from mapreduce_tpu.runtime import executor
 
-            s_cfg = dataclasses.replace(
-                cfg, superstep=int(os.environ.get("BENCH_STREAM_SUPERSTEP",
-                                                  "4")))
-            # Warm-up: a short-range run pays the XLA compiles for the
-            # streamed shapes (the persistent compile cache makes the timed
-            # run's identical programs cache hits), so the timed window
-            # measures ingest, not compilation (BENCHMARKS.md rules).
-            warm_hi = min(len(corpus),
-                          n_dev * s_cfg.chunk_bytes * (s_cfg.superstep + 1))
-            executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
-                             mesh=mesh, byte_range=(0, warm_hi))
-            _log("streamed warm-up done (compile paid)", wall0)
-            t0 = time.perf_counter()
-            rr = executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
-                                  mesh=mesh)
-            np.asarray(jax.tree.leaves(rr.value)[0].ravel()[:1])  # barrier
-            s_dt = time.perf_counter() - t0
-            streamed_gbps = rr.metrics.bytes_processed / 1e9 / s_dt
-            _log(f"streamed ingest pass done: {s_dt:.3f}s over "
-                 f"{rr.metrics.bytes_processed >> 20} MB "
-                 f"({streamed_gbps:.4f} GB/s end-to-end)", wall0)
+                s_cfg = dataclasses.replace(
+                    cfg, superstep=int(os.environ.get(
+                        "BENCH_STREAM_SUPERSTEP", "4")))
+                # Warm-up: a short-range run pays the XLA compiles for the
+                # streamed shapes (the persistent compile cache makes the
+                # timed run's identical programs cache hits), so the timed
+                # window measures ingest, not compilation.
+                warm_hi = min(len(corpus),
+                              n_dev * s_cfg.chunk_bytes
+                              * (s_cfg.superstep + 1))
+                executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
+                                 mesh=mesh, byte_range=(0, warm_hi))
+                _log("streamed warm-up done (compile paid)", wall0)
+                _rearm_watchdog(watchdog_s or 480, wall0)
+                t0 = time.perf_counter()
+                rr = executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
+                                      mesh=mesh)
+                np.asarray(jax.tree.leaves(rr.value)[0].ravel()[:1])
+                s_dt = time.perf_counter() - t0
+                streamed_gbps = rr.metrics.bytes_processed / 1e9 / s_dt
+                _log(f"streamed ingest pass done: {s_dt:.3f}s over "
+                     f"{rr.metrics.bytes_processed >> 20} MB "
+                     f"({streamed_gbps:.4f} GB/s end-to-end)", wall0)
+            except Exception as e:  # noqa: BLE001 — headline must survive
+                _log(f"streamed phase failed ({e!r}); keeping headline", wall0)
     finally:
         os.unlink(path)
 
-    base = cpu_baseline_gbps(corpus[: base_mb << 20], repeats=3)
-
-    result = {
-        "metric": "zipf_wordcount_device_throughput",
-        "input": corpus_name,
-        "h2d_gbps": round(h2d_gbps, 4),
-        "value": round(gbps, 4),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / base, 3) if base else 0.0,
-        # The device-resident slice actually measured (BENCH_SUPERSTEP below
-        # the chunk count truncates to the first k chunks).
-        "corpus_mb": round(group_bytes / (1 << 20), 1),
-        "devices": n_dev,
-        "backend": jax.devices()[0].platform,
-        "total_words": total_words,
-        "cpu_baseline_gbps": round(base, 4),
-        "words_per_s": round(words_per_s, 0),
-    }
+    result = dict(_PARTIAL_RESULT)
     if streamed_gbps is not None:
         result["streamed_ingest_gbps"] = round(streamed_gbps, 4)
     print(json.dumps(result))
-    # Only a real-device run may update the last-good record: a CPU smoke run
-    # would clobber the TPU evidence a wedged later round needs to fall back on.
-    if result["backend"] != "cpu":
-        try:
-            with open(LAST_GOOD_PATH, "w") as f:
-                json.dump({**result, "recorded_at": time.strftime(
-                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
-                f.write("\n")
-        except OSError:
-            pass  # read-only checkout: the run already printed its line
+    _write_last_good(result)
     return 0
 
 

@@ -86,6 +86,7 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 pos_hi: jax.Array | int = 0) -> table_ops.CountTable:
     """Tokenize one buffer with the configured backend and build its table."""
     if config.resolved_backend() == "pallas":
+        from mapreduce_tpu.ops import rescue as rescue_ops
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
         def aggregate(col, seam, overlong):
@@ -94,34 +95,58 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             # free, where a separate seam table + merge cost a second
             # (fixed-overhead-bound) reduce pass per chunk.
             stream = pallas_tok.concat_streams(col, seam)
-            t = table_ops.from_stream(
+            built = table_ops.from_stream(
                 stream, capacity, pos_hi=pos_hi,
                 max_token_bytes=config.pallas_max_token,
-                max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode)
-            # ``overlong`` counts occurrences.  For dropped_count
-            # (occurrences) that is exact; for dropped_uniques it is the
-            # only available upper bound — overlong tokens leave the kernel
-            # unhashed, so distinct overlong words cannot be deduplicated
-            # on device.
-            return t._replace(dropped_uniques=t.dropped_uniques + overlong,
-                              dropped_count=t.dropped_count + overlong)
+                max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode,
+                rescue_slots=config.rescue_slots)
+
+            def accounted(t, n_over):
+                # ``n_over`` counts occurrences.  For dropped_count
+                # (occurrences) that is exact; for dropped_uniques it is the
+                # only available upper bound — unrescued overlong tokens
+                # leave the device unhashed, so their distinct words cannot
+                # be deduplicated.
+                return t._replace(dropped_uniques=t.dropped_uniques + n_over,
+                                  dropped_count=t.dropped_count + n_over)
+
+            if not config.rescue_slots:
+                return accounted(built, overlong)
+            t, rescue_packed = built
+
+            def with_rescue(_):
+                # Exact re-hash of the poison positions (ops/rescue.py):
+                # rescued tokens join the batch table with true keys/
+                # lengths/first occurrences; only the residual stays in
+                # dropped accounting.
+                rt, rescued = rescue_ops.rescue_table(
+                    chunk, rescue_packed, config.pallas_max_token,
+                    config.rescue_window, pos_hi)
+                return accounted(table_ops.merge(t, rt, capacity=capacity),
+                                 overlong - rescued)
+
+            # Overlong-free chunks (both bench corpora, all of test.txt)
+            # skip the windows/re-hash/merge entirely.
+            return jax.lax.cond(overlong > 0, with_rescue,
+                                lambda _: accounted(t, overlong), None)
 
         def full_path(_):
             col, seam, overlong = pallas_tok.tokenize_split(
                 chunk, max_token_bytes=config.pallas_max_token)
             return aggregate(col, seam, overlong)
 
-        if not config.compact_slots:
+        if not config.resolved_compact_slots:
             return full_path(None)
-        # Slot-compacted planes (config.compact_slots): the sort input
-        # shrinks ~1.45x.  A nonzero spill means some (block, lane) window
-        # exceeded its slot budget and the compact planes are incomplete —
-        # the cond then re-runs the chunk at full resolution, so ANY input
-        # stays exact (the compact branch is bit-identical when it runs;
-        # tools/density.py: the default budget never spills on the bench
-        # corpora).
+        # Slot-compacted planes (config.compact_slots, default-on at 88:
+        # +25% end-to-end on the chip, BENCHMARKS.md round 4): the sort
+        # input shrinks ~1.45x.  A nonzero spill means some (block, lane)
+        # window exceeded its slot budget and the compact planes are
+        # incomplete — the cond then re-runs the chunk at full resolution,
+        # so ANY input stays exact (the compact branch is bit-identical
+        # when it runs; tools/density.py: the default budget never spills
+        # on the bench corpora).
         col, seam, overlong, spill = pallas_tok.tokenize_split_compact(
-            chunk, config.compact_slots,
+            chunk, config.resolved_compact_slots,
             max_token_bytes=config.pallas_max_token)
         return jax.lax.cond(
             spill == 0,
@@ -341,16 +366,41 @@ class WordCountJob:
         return "wordcount"
 
 
+class TopKTable(NamedTuple):
+    """A top-k finalized table plus the pre-reorder KMV snapshot.
+
+    ``top_k`` is terminal: its count-descending reorder destroys the
+    key-sorted KMV property, so the distinct estimate's inputs (occupancy
+    and the largest kept key of the FULL table) are captured as scalars
+    first — the Common-Crawl top-k config is exactly where table spill is
+    likely, i.e. where the estimate matters (VERDICT r3 weak #6).  The
+    executor reads the scalars host-side via
+    :func:`mapreduce_tpu.ops.table.kmv_from_snapshot`.
+    """
+
+    table: table_ops.CountTable
+    kmv_n_valid: jax.Array  # uint32: occupancy at snapshot
+    kmv_kth_hi: jax.Array  # uint32: largest kept key, hi lane
+    kmv_kth_lo: jax.Array  # uint32: largest kept key, lo lane
+
+
+def topk_with_snapshot(tbl: table_ops.CountTable, k: int) -> TopKTable:
+    """Snapshot KMV scalars, then apply the terminal top-k reorder."""
+    n_valid, kth_hi, kth_lo = table_ops.kmv_snapshot(tbl)
+    return TopKTable(table_ops.top_k(tbl, k), n_valid, kth_hi, kth_lo)
+
+
 class TopKWordCountJob(WordCountJob):
     """WordCount whose device-side finalize keeps only the k most frequent
-    words (the Common-Crawl top-k benchmark config, BASELINE.md)."""
+    words (the Common-Crawl top-k benchmark config, BASELINE.md), plus the
+    pre-reorder KMV snapshot (:class:`TopKTable`)."""
 
     def __init__(self, k: int, config: Config = DEFAULT_CONFIG):
         super().__init__(config)
         self.k = k
 
     def finalize(self, state):
-        return table_ops.top_k(self._plain_table(state), self.k)
+        return topk_with_snapshot(self._plain_table(state), self.k)
 
     def identity(self) -> str:
         # k only affects finalize, but including it keeps resume semantics
@@ -523,7 +573,7 @@ class NGramCountJob(WordCountJob):
     def finalize(self, state):
         tbl = state.table if isinstance(state, NGramState) \
             else self._plain_table(state)
-        return table_ops.top_k(tbl, self.k) if self.k else tbl
+        return topk_with_snapshot(tbl, self.k) if self.k else tbl
 
     def identity(self) -> str:
         # Resuming a bigram run's snapshot as a trigram run (same shapes!)

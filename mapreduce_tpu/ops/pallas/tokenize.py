@@ -307,6 +307,14 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
     out32 = jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
     scalar = jax.ShapeDtypeStruct((1, 1), jnp.uint32)
     n_scalars = 3 if compact_slots else 2
+    # The slot-compaction mode's per-slot one-hot selects need ~31 MB of
+    # scoped VMEM at S=88 — over Mosaic's 16 MB default stack budget but
+    # comfortably inside v5e's ~128 MB physical VMEM (measured on-chip:
+    # the default limit rejects the kernel with a vmem-stack OOM at
+    # compile time; 64 MB compiles).  The pair path stays well under the
+    # default; one shared limit keeps the call site single-owner.
+    params = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024) \
+        if compact_slots else None
     outs = pl.pallas_call(
         kern,
         grid=(grid,),
@@ -318,6 +326,7 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         + [pl.BlockSpec((1, 1), lambda i: (0, 0),
                         memory_space=pltpu.SMEM)] * n_scalars,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
+        compiler_params=params,
         interpret=interpret,
     )(cols_padded)
     khi, klo, packed, over, ntok = outs[:5]

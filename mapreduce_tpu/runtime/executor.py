@@ -27,7 +27,7 @@ from mapreduce_tpu import constants
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
 from mapreduce_tpu.data import reader as reader_mod
 from mapreduce_tpu.models.wordcount import (WordCountJob, TopKWordCountJob,
-                                            NGramCountJob,
+                                            NGramCountJob, TopKTable,
                                             SketchedState, SketchedWordCountJob,
                                             FreqSketchedState, FreqSketchedWordCountJob,
                                             WordCountResult, apply_top_k,
@@ -211,6 +211,23 @@ def _drive_stream(engine, job, config: Config, path, state,
     return state, bytes_done, step_index
 
 
+def _metrics_word_count(value) -> int:
+    """Total words inside any finalize result shape, for RunMetrics.
+
+    Finalize results nest: sketch wrappers hold a ``.table`` that may itself
+    be a :class:`TopKTable` (top-k + sketch compositions).  Unwrap until the
+    CountTable appears; non-table jobs (grep, sample) report 0 here — their
+    metrics live in their own result fields.
+    """
+    for _ in range(3):
+        if isinstance(value, (SketchedState, FreqSketchedState, TopKTable)):
+            value = value.table
+        else:
+            break
+    return int(value.total_count()) \
+        if isinstance(value, table_ops.CountTable) else 0
+
+
 def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             mesh=None, merge_strategy: str = "tree",
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
@@ -299,9 +316,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     timer.stop("reduce")
     total_s = timer.stop("total")
 
-    result_table = value.table if isinstance(value, SketchedState) else value
-    words = int(result_table.total_count()) \
-        if isinstance(result_table, table_ops.CountTable) else 0
+    words = _metrics_word_count(value)
     # bytes_done is the absolute resume CURSOR (checkpoints store it); the
     # throughput metric counts only bytes this run actually streamed.
     m = metrics_mod.RunMetrics(bytes_processed=bytes_done - range_lo, words_counted=words,
@@ -415,9 +430,7 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     timer.stop("reduce")
     total_s = timer.stop("total")
 
-    result_table = value.table if isinstance(value, SketchedState) else value
-    words = int(result_table.total_count()) \
-        if isinstance(result_table, table_ops.CountTable) else 0
+    words = _metrics_word_count(value)
     m = metrics_mod.RunMetrics(bytes_processed=bytes_done, words_counted=words,
                                elapsed_s=total_s, phases=dict(timer.phases))
     log_event(logger, "global run complete", **m.as_dict())
@@ -519,9 +532,20 @@ def count_file(path, config: Config = DEFAULT_CONFIG, mesh=None,
     elif isinstance(value, FreqSketchedState):
         value, cms = value.table, np.asarray(value.cms)
     # Top-k finalize reorders the table on device, destroying the KMV
-    # property kmv_distinct needs; those runs keep the upper bound.
+    # property — but it snapshots the estimator's scalars first
+    # (TopKTable), so spilled top-k runs still get the tight distinct
+    # estimate instead of the summed upper bound.
+    kmv_est = None
+    if isinstance(value, TopKTable):
+        kmv_est = table_ops.kmv_from_snapshot(
+            int(value.kmv_n_valid), int(value.kmv_kth_hi),
+            int(value.kmv_kth_lo), config.table_capacity)
+        value = value.table
     result = recover_from_file(value, path, rr.bases, n_dev, ngram=ngram,
                                estimate_distinct=not top_k)
+    if kmv_est is not None:
+        result = dataclasses.replace(
+            result, distinct=max(len(result.words), int(round(kmv_est))))
     if registers is not None:
         from mapreduce_tpu.ops import sketch as sketch_ops
 

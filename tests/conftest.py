@@ -17,6 +17,14 @@ from __graft_entry__ import _force_cpu_mesh
 
 jax = _force_cpu_mesh(8)
 
+# Persistent compile cache across suite runs: the compact-default pallas
+# programs compile BOTH cond branches per shape (~doubling round-4 suite
+# compile time); cached repeats cut full-suite wall time several-fold.
+# CPU-backend caching works on this jax; best-effort inside the helper.
+from mapreduce_tpu.runtime.profiling import enable_compile_cache
+
+enable_compile_cache("/tmp/mapreduce_tpu_test_jax_cache")
+
 import numpy as np
 import pytest
 

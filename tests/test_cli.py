@@ -94,11 +94,19 @@ def test_top_k(tmp_path):
 
 def test_max_token_bytes_flag_on_pallas_backend(tmp_path):
     """--max-token-bytes reaches the pallas config: a token longer than W is
-    dropped into the accounting, shorter ones count normally."""
+    rescued exactly by default (ops/rescue.py), and dropped into the
+    accounting with --rescue-overlong 0 (the round-3 contract)."""
     f = tmp_path / "in.txt"
     f.write_text("short " + "L" * 40 + " short\n")
-    r = _run([str(f), "--format", "json", "--backend", "pallas",
-              "--chunk-bytes", str(128 * 18), "--max-token-bytes", "8"])
+    base = [str(f), "--format", "json", "--backend", "pallas",
+            "--chunk-bytes", str(128 * 18), "--max-token-bytes", "8"]
+    r = _run(base)
+    assert r.returncode == 0, r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["counts"] == [["short", 2], ["L" * 40, 1]]
+    assert obj["total"] == 3 and obj["dropped_count"] == 0
+
+    r = _run(base + ["--rescue-overlong", "0"])
     assert r.returncode == 0, r.stderr
     obj = json.loads(r.stdout)
     assert obj["counts"] == [["short", 2]]

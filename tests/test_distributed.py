@@ -85,9 +85,14 @@ def test_top_k_job(mesh8, rng):
     eng = Engine(TopKWordCountJob(10, CFG), mesh8)
     batches = [b.data for b in _batches(corpus, 8, CFG.chunk_bytes)]
     result = eng.run(batches)
-    got = sorted(np.asarray(result.count)[np.asarray(result.count) > 0].tolist(), reverse=True)
+    # Top-k finalize bundles the table with its pre-reorder KMV snapshot.
+    tbl = result.table
+    got = sorted(np.asarray(tbl.count)[np.asarray(tbl.count) > 0].tolist(), reverse=True)
     expected = sorted(oracle.word_counts(corpus).values(), reverse=True)[:10]
     assert got == expected
+    # Nothing spilled here: occupancy below capacity, so no estimate — the
+    # snapshot still reports the true occupancy.
+    assert int(result.kmv_n_valid) == len(oracle.word_counts(corpus))
 
 
 def test_psum_collective(mesh8):

@@ -54,6 +54,23 @@ def test_run_metrics(tmp_path, rng):
     assert "stream" in rr.metrics.phases and "reduce" in rr.metrics.phases
 
 
+def test_run_metrics_unwrap_topk_and_sketch(tmp_path, rng):
+    """words_counted must survive every finalize result shape: the TopKTable
+    wrapper (and its nesting inside sketch states) carries the table one
+    level down — metrics reporting 0 there is a silent regression."""
+    from mapreduce_tpu.models.wordcount import (SketchedWordCountJob,
+                                                TopKWordCountJob)
+
+    corpus = make_corpus(rng, 2000, 100)
+    path = _write(tmp_path, corpus)
+    total = oracle.total_count(corpus)
+    rr = executor.run_job(TopKWordCountJob(5, CFG), path, CFG, mesh=data_mesh(4))
+    assert rr.metrics.words_counted == total
+    rr = executor.run_job(SketchedWordCountJob(TopKWordCountJob(5, CFG)),
+                          path, CFG, mesh=data_mesh(4))
+    assert rr.metrics.words_counted == total
+
+
 def test_checkpoint_resume_same_result(tmp_path, rng):
     """Kill-and-resume produces the identical count multiset (SURVEY §5)."""
     corpus = make_corpus(rng, 5000, 200)

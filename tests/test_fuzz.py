@@ -50,12 +50,21 @@ def test_words_at_length_envelope(config):
 
 
 def test_pallas_drops_only_overlong(rng):
-    """Mixed stream: pallas result == oracle minus tokens longer than W."""
+    """Mixed stream: with rescue off, pallas == oracle minus tokens longer
+    than W (the accounting contract); the default rescue counts them too
+    (tests/test_rescue.py owns that surface)."""
     words = [b"ok", b"c" * 33, b"fine", b"d" * 100, b"ok"]
     data = b" ".join(words)
-    r = wordcount.count_words(data, PALLAS)
+    import dataclasses
+
+    r = wordcount.count_words(
+        data, dataclasses.replace(PALLAS, rescue_overlong=0))
     assert r.as_dict() == {b"ok": 2, b"fine": 1}
     assert r.dropped_count == 2 and r.total == 5
+    # Default config: the same stream counts exactly.
+    r2 = wordcount.count_words(data, PALLAS)
+    assert r2.as_dict() == {b"ok": 2, b"c" * 33: 1, b"fine": 1, b"d" * 100: 1}
+    assert r2.dropped_count == 0 and r2.total == 5
 
 
 @pytest.mark.parametrize("seed", range(4))

@@ -92,29 +92,35 @@ def main() -> int:
                           "running bench suite")
             env = {**os.environ, "BENCH_PROBE": "1",
                    "BENCH_PROBE_BUDGET_S": "120"}
+            # A/B rows skip the streamed post-phase (BENCH_STREAMED=0): it
+            # costs ~5 min of window per run and only the headline and the
+            # stacked-candidate rows need the end-to-end ingest number.
+            ab = {**env, "BENCH_STREAMED": "0"}
             steps = [
                 ("bench-zipf", [sys.executable, "bench.py"], env),
                 ("sortbench", [sys.executable, "tools/sortbench.py"], env),
                 ("bench-zipf-segmin", [sys.executable, "bench.py"],
-                 {**env, "BENCH_SORT_MODE": "segmin"}),
+                 {**ab, "BENCH_SORT_MODE": "segmin"}),
                 ("bench-natural-100mb", [sys.executable, "bench.py"],
-                 {**env, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
+                 {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
                 ("bench-zipf-chunk64", [sys.executable, "bench.py"],
-                 {**env, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
+                 {**ab, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
                 ("bench-zipf-merge8", [sys.executable, "bench.py"],
-                 {**env, "BENCH_MERGE_EVERY": "8"}),
-                ("bench-zipf-compact88", [sys.executable, "bench.py"],
-                 {**env, "BENCH_COMPACT_SLOTS": "88"}),
-                ("bench-zipf-stacked", [sys.executable, "bench.py"],
-                 {**env, "BENCH_COMPACT_SLOTS": "88", "BENCH_MERGE_EVERY": "8",
-                  "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
+                 {**ab, "BENCH_MERGE_EVERY": "8"}),
+                # compact_slots defaults ON since round 4 (+25% measured);
+                # the A/B row now measures the OFF path for regressions.
+                ("bench-zipf-nocompact", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_COMPACT_SLOTS": "0"}),
+                ("bench-webby", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_CORPUS": "webby", "BENCH_MB": "64",
+                  "BENCH_REPEATS": "4"}),
                 ("opshare-sort3", [sys.executable, "tools/opshare.py"], env),
                 ("opshare-segmin", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_SORT_MODE": "segmin"}),
                 ("opshare-merge8", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_MERGE_EVERY": "8"}),
-                ("opshare-compact88", [sys.executable, "tools/opshare.py"],
-                 {**env, "OPSHARE_COMPACT_SLOTS": "88"}),
+                ("opshare-nocompact", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_COMPACT_SLOTS": "0"}),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}

@@ -70,11 +70,24 @@ def main() -> int:
     from mapreduce_tpu.parallel.mapreduce import Engine
     from mapreduce_tpu.parallel.mesh import data_mesh
 
+    sort_mode = os.environ.get("OPSHARE_SORT_MODE", "sort3")
+    if sort_mode == "segmin" and jax.default_backend() == "tpu" \
+            and os.environ.get("OPSHARE_FORCE", "0") != "1":
+        # Measured 2026-07-31: the 16.8M-row segmented associative_scan
+        # wedges the tunnel chip for >30 min (twice in sortbench, once as a
+        # full bench watchdog abort) — refusing beats burning half a live
+        # window.  OPSHARE_FORCE=1 overrides (e.g. direct-attached chip).
+        print(json.dumps({"skipped": "segmin on tpu: giant associative_scan "
+                                     "wedges the tunnel chip (BENCHMARKS.md "
+                                     "round-4)"}))
+        return 0
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
-                 sort_mode=os.environ.get("OPSHARE_SORT_MODE", "sort3"),
+                 sort_mode=sort_mode,
                  merge_every=int(os.environ.get("OPSHARE_MERGE_EVERY", "1")),
-                 compact_slots=int(os.environ.get("OPSHARE_COMPACT_SLOTS", "0")))
+                 compact_slots=(int(os.environ["OPSHARE_COMPACT_SLOTS"])
+                                if "OPSHARE_COMPACT_SLOTS" in os.environ
+                                else None))
     print(f"backend={jax.default_backend()} chunk={chunk_mb}MB "
           f"sort_mode={cfg.sort_mode} merge_every={cfg.merge_every} "
           f"steps={steps}", file=sys.stderr)
