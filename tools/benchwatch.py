@@ -99,8 +99,9 @@ def main() -> int:
             steps = [
                 ("bench-zipf", [sys.executable, "bench.py"], env),
                 ("sortbench", [sys.executable, "tools/sortbench.py"], env),
-                ("bench-zipf-segmin", [sys.executable, "bench.py"],
-                 {**ab, "BENCH_SORT_MODE": "segmin"}),
+                # segmin's stream-sized associative_scan wedges the chip
+                # (3 observations, BENCHMARKS.md round 4) — no bench row;
+                # sortbench's gated SORTBENCH_SCAN=1 path covers it off-TPU.
                 ("bench-natural-100mb", [sys.executable, "bench.py"],
                  {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
                 ("bench-zipf-chunk64", [sys.executable, "bench.py"],
