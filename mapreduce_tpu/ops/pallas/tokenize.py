@@ -106,43 +106,63 @@ def _compact_planes(khi, klo, packed, has, slots: int):
 
     ``has`` marks live pair rows (emission or poison).  Per lane, live rows
     keep their order and pack into the first ``rank`` output slots; the
-    rest fill with the all-ones sentinel.  Rank comes from a log-shift
-    cumsum along sublanes; selection is a one-hot masked sum per slot —
-    exactly one row per (slot, lane) matches, so the int32 "sum" is a pure
-    bit-preserving select (Mosaic cannot reduce unsigned ints; a one-hot
-    sum never actually adds).  Work is bounded by the r >= s triangle:
-    rank[r] <= r+1, so slot s can only come from rows >= s.
+    rest fill with the all-ones sentinel.
+
+    Algorithm: log-shift compaction.  Each live row must move UP (toward
+    row 0) by ``d = #dead rows above it`` — d is non-decreasing down a
+    lane, so applying its binary decomposition one bit at a time (shift by
+    2^b where bit b of the remaining distance is set) can never collide:
+    if the element at row j still has to travel >= 2^b, every row between
+    its destination and j holds either a hole or an element also moving.
+    Monotonicity survives each pass (clearing low bits preserves order),
+    so log2(p) passes of three (p, L) selects replace the previous
+    per-slot one-hot selection — O(p log p) VPU work instead of
+    O(p * slots), measured ~20 ms/chunk of kernel time at S=88
+    (BENCHMARKS.md round 4), and a scoped-VMEM footprint back near the
+    pair path's.
 
     Returns (khi[slots,L], klo[slots,L], packed[slots,L], n_spilled) where
     n_spilled counts live rows beyond the per-lane budget — the caller's
     exactness fallback trigger.
     """
-    p = has.shape[0]
+    p, lanes = has.shape
     rank = has.astype(jnp.int32)
     k = 1
     while k < p:  # inclusive cumsum along sublanes: log-shift adds
-        top = jnp.zeros((k, rank.shape[1]), jnp.int32)
+        top = jnp.zeros((k, lanes), jnp.int32)
         rank = rank + jnp.concatenate([top, rank[:-k]], axis=0)
         k *= 2
     lane_live = rank[p - 1:p, :]  # (1, L) live rows per lane
     spilled = jnp.maximum(lane_live - slots, 0)
     n_spilled = jnp.sum(spilled).astype(jnp.uint32)
 
-    khi_i = khi.astype(jnp.int32)
-    klo_i = klo.astype(jnp.int32)
-    pck_i = packed.astype(jnp.int32)
-    sent_row = jnp.full((1, has.shape[1]), 0xFFFFFFFF, jnp.uint32)
-    out_khi, out_klo, out_pck = [], [], []
-    for s in range(slots):
-        onehot = has[s:, :] & (rank[s:, :] == s + 1)
-        sel = lambda v: jnp.sum(jnp.where(onehot, v[s:, :], 0), axis=0,
-                                keepdims=True).astype(jnp.uint32)
-        live = lane_live > s  # (1, L): slot s used in this lane
-        out_khi.append(jnp.where(live, sel(khi_i), sent_row))
-        out_klo.append(jnp.where(live, sel(klo_i), sent_row))
-        out_pck.append(jnp.where(live, sel(pck_i), sent_row))
-    cat = lambda xs: jnp.concatenate(xs, axis=0)
-    return cat(out_khi), cat(out_klo), cat(out_pck), n_spilled
+    row = jax.lax.broadcasted_iota(jnp.int32, (p, lanes), 0)
+    dist = jnp.where(has, row - (rank - 1), 0)  # dead rows above each live row
+    vals = [khi.astype(jnp.int32), klo.astype(jnp.int32),
+            packed.astype(jnp.int32)]
+    # Masks ride as int32 0/1 planes: Mosaic cannot shift/concatenate i1
+    # vector registers ("Invalid vector register cast" on the chip), the
+    # same class of constraint as the int32-widened separator test above.
+    live = has.astype(jnp.int32)
+    s = 1
+    while s < p:
+        def up(x):  # x[i] <- x[i+s] (shift toward row 0); int32 planes only
+            pad = jnp.zeros((s, lanes), jnp.int32)
+            return jnp.concatenate([x[s:], pad], axis=0)
+
+        src_live = up(live)
+        src_dist = up(dist)
+        move_in = (src_live != 0) & ((src_dist & s) != 0)
+        stay = (live != 0) & ((dist & s) == 0)
+        vals = [jnp.where(move_in, up(v), jnp.where(stay, v, -1))
+                for v in vals]
+        dist = jnp.where(move_in, src_dist - s, dist)
+        live = (move_in | stay).astype(jnp.int32)
+        s *= 2
+    sent = jnp.uint32(0xFFFFFFFF)
+    out = [jnp.where(live[:slots] != 0, v[:slots].astype(jnp.uint32), sent)
+           for v in vals]
+    return out[0], out[1], out[2], n_spilled
 
 
 def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
