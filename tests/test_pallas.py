@@ -223,6 +223,32 @@ def test_compact_map_stream_falls_back_exactly(rng):
         _assert_tables_equal(want, t)
 
 
+def test_compact_density_sweep_bit_identical(rng):
+    """Log-shift compaction across the density spectrum: separator-heavy
+    (long movement distances), long runs (overlong poison rows riding the
+    shift), and mixed densities — every no-spill case must equal the
+    FULL-RESOLUTION pallas table bit for bit (the compaction invariant;
+    the full path owns the W contract, so overlong mixes are in scope).
+    Guards the shift algorithm's distance bookkeeping (movement = per-lane
+    dead-row count, applied one binary bit per pass), whose failure modes
+    are density-dependent in ways the two bench corpora never exercise."""
+    cases = [
+        b" " * 4000 + b"word " * 20,               # almost-empty lanes
+        (b"a" * 30 + b" ") * 300,                  # overlong runs: poisons move
+        b"ab " * 1500,                             # density 1/3
+        b"abcd " * 1000,                           # density 1/5
+        bytes(rng.integers(97, 100, 6000).tobytes())
+        .replace(b"c", b" "),                      # random ~1/3 separators
+        (b"w " * 10 + b"token " + b"\n") * 250,    # dense-but-fitting lanes
+    ]
+    for data in cases:
+        _, got_full, overlong_full = _tables(data)
+        got, overlong_c, spill = _compact_table(data, slots=24)
+        assert spill == 0, data[:20]
+        assert overlong_c == overlong_full
+        _assert_tables_equal(got_full, got)
+
+
 def test_compact_overlong_accounting(rng):
     """Overlong poison rows survive compaction: dropped_* match the full
     path's accounting bit for bit."""
