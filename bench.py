@@ -253,7 +253,11 @@ def _arm_watchdog(seconds: int, wall0: float) -> None:
         if _PARTIAL_RESULT is not None:
             _log("WATCHDOG: post-window phase hung — emitting the partial "
                  "headline result instead of discarding it", wall0)
-            print(json.dumps(_PARTIAL_RESULT), flush=True)
+            # Self-describing partial: consumers must be able to tell
+            # "streamed intentionally skipped" from "streamed wedged"
+            # without reading stderr (ADVICE r4).
+            print(json.dumps({**_PARTIAL_RESULT, "partial": True,
+                              "streamed_phase": "hung"}), flush=True)
             os._exit(0)
         _log(f"WATCHDOG: no completion after {seconds}s — device tunnel "
              "wedged or unreachable; aborting", wall0)

@@ -45,7 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reported entries are the exact source spans, e.g. "
                         "'Hello World'; --stream counts grams exactly, "
                         "including ones spanning chunk seams)")
-    p.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    p.add_argument("--chunk-bytes", type=int, default=1 << 25,
+                   help="bytes per device step (default 32 MB, the measured "
+                        "v5e sweet spot; small inputs are never padded up "
+                        "to this)")
     p.add_argument("--table-capacity", type=int, default=1 << 18)
     p.add_argument("--format", choices=("reference", "json", "tsv"), default="reference",
                    help="'reference' replicates the CUDA program's stdout shape")
@@ -456,29 +459,47 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 3
 
+    if args.sort_mode == "segmin":
+        from mapreduce_tpu.config import SEGMIN_TPU_ERROR, segmin_allowed
+
+        # Fail with a clean message before any device work when a non-CPU
+        # platform is configured.  With NO platform configured (effective
+        # ''), jax may still resolve a local TPU — that case is caught by
+        # the deep trace-time guard in ops.table.from_packed_rows, whose
+        # ValueError the compute paths below surface as a clean exit 2.
+        if effective not in ("", "cpu") and not segmin_allowed():
+            print(f"error: {SEGMIN_TPU_ERROR}", file=sys.stderr)
+            return 2
+
     if args.grep is not None:
         return _grep_main(args, paths, data, config, input_bytes)
     if args.sample is not None:
         return _sample_main(args, paths, data, config, input_bytes)
 
     t0 = time.perf_counter()
-    with profiling.trace(args.profile):
-        if args.stream:
-            from mapreduce_tpu.runtime.executor import count_file
+    try:
+        with profiling.trace(args.profile):
+            if args.stream:
+                from mapreduce_tpu.runtime.executor import count_file
 
-            result = count_file(paths, config=config, top_k=args.top_k or None,
-                                distinct_sketch=args.distinct_sketch,
-                                count_sketch=args.count_sketch or bool(args.estimate),
-                                ngram=args.ngram,
-                                merge_strategy=args.merge_strategy,
-                                checkpoint_path=args.checkpoint,
-                                checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
-                                retry=args.retry)
-        else:
-            from mapreduce_tpu.models import wordcount
+                result = count_file(paths, config=config, top_k=args.top_k or None,
+                                    distinct_sketch=args.distinct_sketch,
+                                    count_sketch=args.count_sketch or bool(args.estimate),
+                                    ngram=args.ngram,
+                                    merge_strategy=args.merge_strategy,
+                                    checkpoint_path=args.checkpoint,
+                                    checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
+                                    retry=args.retry)
+            else:
+                from mapreduce_tpu.models import wordcount
 
-            result = wordcount.count_ngrams(data, args.ngram, config) \
-                if args.ngram > 1 else wordcount.count_words(data, config)
+                result = wordcount.count_ngrams(data, args.ngram, config) \
+                    if args.ngram > 1 else wordcount.count_words(data, config)
+    except ValueError as e:
+        # Config-vs-platform refusals raised at trace time (e.g. the segmin
+        # TPU wedge guard) exit cleanly like the grep/sample paths do.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     elapsed = time.perf_counter() - t0
 
     if args.top_k and not args.stream:  # stream mode already applied top-k

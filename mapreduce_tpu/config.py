@@ -12,6 +12,24 @@ import dataclasses
 from typing import Optional
 
 
+SEGMIN_TPU_ERROR = (
+    "sort_mode='segmin' is disabled on the TPU backend: its stream-sized "
+    "associative_scan wedges the chip for >30 min (measured 3x, BENCHMARKS.md "
+    "round 4) — on a shared device that takes down every tenant.  Use "
+    "sort_mode='sort3' (bit-identical results), run the A/B on CPU, or set "
+    "MAPREDUCE_ALLOW_SEGMIN=1 to re-measure deliberately.")
+
+
+def segmin_allowed() -> bool:
+    """Single owner of the MAPREDUCE_ALLOW_SEGMIN override parse: the raw
+    string truthiness trap ('0' would bypass the wedge guard) is avoided by
+    treating only explicit affirmative values as opt-in."""
+    import os
+
+    return os.environ.get("MAPREDUCE_ALLOW_SEGMIN", "").lower() \
+        in ("1", "true", "yes")
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     """Sizing and execution knobs for a MapReduce run.
@@ -19,7 +37,12 @@ class Config:
     Attributes:
       chunk_bytes: bytes per device step per device.  The unit of streaming;
         each jitted step consumes this many bytes on every device.  Must be a
-        multiple of 128 for TPU lane alignment.
+        multiple of 128 for TPU lane alignment.  Default 32 MB: the measured
+        sweet spot on v5e (BENCHMARKS.md round 4: 64 MB chunks LOSE ~15-40%
+        end-to-end — sort cost is superlinear in rows and HBM pressure grows —
+        and 1 MB chunks leave dispatch overhead unamortized).  Single-buffer
+        entry points never pad small inputs up to this (padding is to the
+        input's own length), so the default only shapes streamed runs.
       table_capacity: distinct keys the running count table can hold (per
         final table).  Beyond this, rarest-by-arrival keys spill and are
         tallied in ``dropped_*`` diagnostics rather than silently corrupting
@@ -41,10 +64,13 @@ class Config:
       superstep: chunks folded into ONE dispatch per device via ``lax.scan``
         (Engine.step_many).  >1 amortizes per-dispatch overhead — decisive on
         high-latency device links — at the cost of staging superstep *
-        chunk_bytes input per device per dispatch.
+        chunk_bytes input per device per dispatch.  Default 1 (lowest memory,
+        per-step checkpoint granularity); on a high-latency link (e.g. a
+        tunneled relay, ~0.6 s/dispatch measured) raise it toward
+        resident-corpus size — bench.py's timed window uses exactly that.
     """
 
-    chunk_bytes: int = 1 << 20
+    chunk_bytes: int = 1 << 25
     table_capacity: int = 1 << 18
     batch_unique_capacity: Optional[int] = None
     mesh_axis: str = "data"
@@ -76,7 +102,12 @@ class Config:
     # first occurrence; 'segmin' sorts with only the two key lanes in the
     # comparator (packed rides as payload) and recovers first occurrence
     # with a segmented running-min instead.  Bit-identical results;
-    # tools/sortbench.py measures both on the real chip.
+    # tools/sortbench.py measures both.  'segmin' is REFUSED on the TPU
+    # backend at trace time: its stream-sized associative_scan wedges the
+    # chip for >30 min (3 independent observations, BENCHMARKS.md round 4)
+    # — a one-flag footgun on a shared device.  The CPU A/B stays alive
+    # (tests, sortbench's gated scan path); MAPREDUCE_ALLOW_SEGMIN=1
+    # overrides for deliberate re-measurement.
     sort_mode: str = "sort3"
     # Slot-compact the pallas kernel's column planes to S output rows per
     # block_rows-byte (block, lane) window instead of the pair path's

@@ -122,8 +122,13 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 rt, rescued = rescue_ops.rescue_table(
                     chunk, rescue_packed, config.pallas_max_token,
                     config.rescue_window, pos_hi)
+                # rescued <= overlong holds by construction (one poison per
+                # overlong run); the clamp bounds any future kernel drift
+                # that double-emits a poison to an accounting error instead
+                # of a silent uint32 wrap of dropped_count to ~2**32.
+                residual = overlong - jnp.minimum(rescued, overlong)
                 return accounted(table_ops.merge(t, rt, capacity=capacity),
-                                 overlong - rescued)
+                                 residual)
 
             # Overlong-free chunks (both bench corpora, all of test.txt)
             # skip the windows/re-hash/merge entirely.

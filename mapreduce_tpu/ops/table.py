@@ -327,6 +327,13 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     if rescue_slots and sort_mode != "sort3":
         raise ValueError("rescue_slots requires sort_mode='sort3' (poison "
                          "extraction rides the third sort key)")
+    if sort_mode == "segmin":
+        from mapreduce_tpu.config import SEGMIN_TPU_ERROR, segmin_allowed
+
+        # Refuse the measured chip-wedge at trace time (the CPU A/B stays
+        # alive); config.segmin_allowed owns the deliberate override.
+        if jax.default_backend() == "tpu" and not segmin_allowed():
+            raise ValueError(SEGMIN_TPU_ERROR)
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
     n = key_hi.shape[0]

@@ -60,3 +60,48 @@ def test_pallas_chunk_floor_enforced_only_for_explicit_pallas():
 def test_chunk_bytes_alignment():
     with pytest.raises(ValueError, match="multiple of 128"):
         Config(chunk_bytes=1000)
+
+
+def test_defaults_match_measured_decisions():
+    """Pin the production defaults to the round-4 on-chip measurements
+    (BENCHMARKS.md "Round 4: the full suite"): 32 MB chunks beat both 1 MB
+    (dispatch-bound) and 64 MB (sort superlinear + HBM pressure); slot
+    compaction default-on at 88 (+25%); merge_every=1 (batching measured a
+    loss on top of compaction); sort3 (segmin wedges the chip).  A default
+    drifting from the measured winner should fail loudly here (VERDICT r4
+    weak #2: "production defaults ignore the round's own measurements")."""
+    cfg = Config()
+    assert cfg.chunk_bytes == 1 << 25  # 32 MB
+    assert cfg.resolved_compact_slots == 88
+    assert cfg.merge_every == 1
+    assert cfg.sort_mode == "sort3"
+    assert cfg.rescue_slots == 1024
+
+    # The CLI must hand users the same measured-optimal shape with no flags.
+    from mapreduce_tpu.cli import build_parser
+
+    args = build_parser().parse_args([])
+    assert args.chunk_bytes == cfg.chunk_bytes
+    assert args.merge_every == cfg.merge_every
+    assert args.sort_mode == cfg.sort_mode
+    assert args.compact_slots is None  # auto -> resolved_compact_slots
+
+
+def test_segmin_refused_on_tpu(monkeypatch):
+    """The segmin TPU wedge guard (VERDICT r4 weak #3): tracing the packed
+    aggregation with sort_mode='segmin' while the default backend is TPU
+    must refuse, unless MAPREDUCE_ALLOW_SEGMIN opts in deliberately."""
+    import jax.numpy as jnp
+
+    from mapreduce_tpu.ops import table as table_ops
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("MAPREDUCE_ALLOW_SEGMIN", raising=False)
+    k = jnp.zeros((8,), jnp.uint32)
+    p = jnp.full((8,), 0xFFFFFFFF, dtype=jnp.uint32)
+    with pytest.raises(ValueError, match="segmin.*disabled|disabled.*segmin"):
+        table_ops.from_packed_rows(k, k, p, jnp.uint32(0), 4, 0,
+                                   sort_mode="segmin")
+    monkeypatch.setenv("MAPREDUCE_ALLOW_SEGMIN", "1")
+    table_ops.from_packed_rows(k, k, p, jnp.uint32(0), 4, 0,
+                               sort_mode="segmin")  # override path stays alive

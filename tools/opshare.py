@@ -128,6 +128,7 @@ def main() -> int:
     fam_us: dict[str, float] = defaultdict(float)
     op_us: dict[str, float] = defaultdict(float)
     program_us = 0.0
+    program_lines = 0  # device lines that carried jit_ spans (one per device)
     for xs in xspaces:
         pd = jax.profiler.ProfileData.from_serialized_xspace(
             open(xs, "rb").read())
@@ -141,16 +142,25 @@ def main() -> int:
                 # (the python line would double-count wall time).
                 if not (device_plane or line.name.startswith("tf_XLA")):
                     continue
+                line_program_us = 0.0
                 for ev in line.events:
                     if "::" in ev.name:  # runtime infra spans nest over ops
                         continue
                     dur = ev.duration_ns / 1e3
                     if ev.name.startswith("jit_"):
-                        program_us += dur
+                        line_program_us += dur
                     if _wrapper.match(ev.name):
                         continue
                     fam_us[classify(ev.name)] += dur
                     op_us[ev.name] += dur
+                # Each device line replays the same program on a mesh run;
+                # summing across lines would report D devices' spans as one
+                # chunk's cost (ADVICE r4).  Average over the lines that
+                # carried program spans instead (on the single-device bench
+                # chip this is a no-op: one line, same number).
+                if line_program_us:
+                    program_us += line_program_us
+                    program_lines += 1
     total = sum(fam_us.values())
     if total <= 0:
         print(json.dumps({"error": "no device events captured",
@@ -171,11 +181,16 @@ def main() -> int:
         "sort_mode": cfg.sort_mode, "merge_every": cfg.merge_every,
         "compact_slots": cfg.compact_slots,
         "total_device_us": round(total, 0),
-        "us_per_chunk": round(total / steps, 0),
-        # The jit program span: wall-anchored per-chunk cost (leaf total
-        # under-counts whatever the profiler didn't attribute to an op).
-        "program_us_per_chunk": round(program_us / steps, 0)
+        # Per-chunk numbers are averaged over the device lines that carried
+        # program spans: on a D-device mesh every line replays the same
+        # program, so raw sums would report D devices' work as one chunk's
+        # cost (ADVICE r4) — and the leaf total must be scaled the same way
+        # as the program span or their calibration gap becomes a Dx phantom.
+        # Single-device runs (the bench chip): one line, numbers unchanged.
+        "us_per_chunk": round(total / steps / max(program_lines, 1), 0),
+        "program_us_per_chunk": round(program_us / program_lines / steps, 0)
         if program_us else None,
+        "program_device_lines": program_lines or None,
         "sort_share": round(fam_us.get("sort", 0.0) / total, 4),
         "shares": {k: round(v / total, 4) for k, v in fam_us.items()},
     }))
