@@ -366,7 +366,8 @@ def main() -> int:
     # so live windows can A/B the sort floor and the merge amortization.
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
-                 sort_mode=os.environ.get("BENCH_SORT_MODE", "sort3"),
+                 sort_mode=os.environ.get("BENCH_SORT_MODE",
+                                          Config.sort_mode),
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ
@@ -484,9 +485,17 @@ def main() -> int:
                 np.asarray(jax.tree.leaves(rr.value)[0].ravel()[:1])
                 s_dt = time.perf_counter() - t0
                 streamed_gbps = rr.metrics.bytes_processed / 1e9 / s_dt
+                # Decomposition (VERDICT r4 next #2): where the streamed
+                # seconds actually went — read_wait (reader behind),
+                # stage (host assembly + H2D placement), dispatch
+                # (program enqueue; large = device queue full =
+                # compute-bound), drain (queued compute at stream end).
+                streamed_phases = {k: round(v, 3)
+                                   for k, v in rr.metrics.phases.items()}
                 _log(f"streamed ingest pass done: {s_dt:.3f}s over "
                      f"{rr.metrics.bytes_processed >> 20} MB "
-                     f"({streamed_gbps:.4f} GB/s end-to-end)", wall0)
+                     f"({streamed_gbps:.4f} GB/s end-to-end); "
+                     f"phases={streamed_phases}", wall0)
             except Exception as e:  # noqa: BLE001 — headline must survive
                 _log(f"streamed phase failed ({e!r}); keeping headline", wall0)
     finally:
@@ -495,6 +504,7 @@ def main() -> int:
     result = dict(_PARTIAL_RESULT)
     if streamed_gbps is not None:
         result["streamed_ingest_gbps"] = round(streamed_gbps, 4)
+        result["streamed_phases"] = streamed_phases
     print(json.dumps(result))
     _write_last_good(result)
     return 0

@@ -23,7 +23,7 @@ import json
 import sys
 import time
 
-from mapreduce_tpu.config import Config
+from mapreduce_tpu.config import Config, PlatformRefusedError
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -120,11 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the aggregation sort's input ~1.45x at S=88; "
                         "windows denser than S fall back to the full path "
                         "for that chunk (always exact)")
-    p.add_argument("--sort-mode", choices=("sort3", "segmin"), default="sort3",
+    p.add_argument("--sort-mode", choices=("sort3", "stable2", "segmin"),
+                   default="stable2",
                    help="aggregation sort strategy on the pallas fast path "
-                        "(bit-identical results; 'segmin' trades the third "
-                        "sort key for a segmented min scan — see "
-                        "tools/sortbench.py)")
+                        "(bit-identical results): 'stable2' drops the third "
+                        "sort key via a lane-major kernel layout + stable "
+                        "2-key sort; 'segmin' trades it for a segmented min "
+                        "scan (CPU only — wedges the TPU). See "
+                        "tools/sortbench.py")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -495,9 +498,10 @@ def main(argv: list[str] | None = None) -> int:
 
                 result = wordcount.count_ngrams(data, args.ngram, config) \
                     if args.ngram > 1 else wordcount.count_words(data, config)
-    except ValueError as e:
-        # Config-vs-platform refusals raised at trace time (e.g. the segmin
-        # TPU wedge guard) exit cleanly like the grep/sample paths do.
+    except PlatformRefusedError as e:
+        # Config-vs-platform refusals raised at trace time (the segmin TPU
+        # wedge guard) exit cleanly; any OTHER ValueError is a real bug and
+        # keeps its traceback.
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0

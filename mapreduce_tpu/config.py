@@ -12,6 +12,13 @@ import dataclasses
 from typing import Optional
 
 
+class PlatformRefusedError(ValueError):
+    """A config knob refused against the resolved runtime platform (raised
+    at trace time, after construction-time validation can no longer see the
+    platform).  The CLI maps exactly this to a clean exit instead of
+    blanket-catching ValueError around all compute."""
+
+
 SEGMIN_TPU_ERROR = (
     "sort_mode='segmin' is disabled on the TPU backend: its stream-sized "
     "associative_scan wedges the chip for >30 min (measured 3x, BENCHMARKS.md "
@@ -99,7 +106,17 @@ class Config:
     # floor: the 3-array sort over the pair-compacted stream is 25-85 ms of
     # the ~102 ms chunk budget, BENCHMARKS.md).  'sort3' (default) carries
     # `packed` as a third sort key so each key segment's head row is its
-    # first occurrence; 'segmin' sorts with only the two key lanes in the
+    # first occurrence.  'stable2' drops the third comparator key: the
+    # kernel writes its compacted planes LANE-MAJOR (flattened stream in
+    # global byte-position order) and a STABLE two-key sort recovers first
+    # occurrence from tie order — the round-4 sortbench measured the
+    # comparator-width cut at ~40% of the sort's compute (173.8 -> 144.9 ms
+    # incl. dispatch, 16.8M rows).  Requires the compact kernel path
+    # (compact_slots > 0); window geometry moves to block_rows=384 /
+    # 128 slots (measured spill-free: max 114 ends per 384-byte window on
+    # Zipf, 75 natural — tools/density.py), whose transposed (128, 128)
+    # output blocks are fully tile-aligned stores.  'segmin' sorts with
+    # only the two key lanes in the
     # comparator (packed rides as payload) and recovers first occurrence
     # with a segmented running-min instead.  Bit-identical results;
     # tools/sortbench.py measures both.  'segmin' is REFUSED on the TPU
@@ -107,8 +124,13 @@ class Config:
     # chip for >30 min (3 independent observations, BENCHMARKS.md round 4)
     # — a one-flag footgun on a shared device.  The CPU A/B stays alive
     # (tests, sortbench's gated scan path); MAPREDUCE_ALLOW_SEGMIN=1
-    # overrides for deliberate re-measurement.
-    sort_mode: str = "sort3"
+    # overrides for deliberate re-measurement.  Default 'stable2': measured
+    # on-chip 2026-07-31 (round 5) against the same-day sort3 records —
+    # zipf 0.4263 vs 0.4024 GB/s, natural 0.3653 vs 0.3448, webby (rescue
+    # firing) 0.2748 vs 0.2659 — with the bit-identity suite
+    # (tests/test_stable2.py) and an on-chip kernel parity smoke
+    # (tools/kernel_smoke.py) holding both modes equal.
+    sort_mode: str = "stable2"
     # Slot-compact the pallas kernel's column planes to S output rows per
     # block_rows-byte (block, lane) window instead of the pair path's
     # block_rows/2 (VERDICT r4 #2: the sort floor is row-count-bound).  At
@@ -151,8 +173,19 @@ class Config:
                 f"sketch_flush_every must be >= 1, got {self.sketch_flush_every}")
         if self.backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.sort_mode not in ("sort3", "segmin"):
+        if self.sort_mode not in ("sort3", "stable2", "segmin"):
             raise ValueError(f"unknown sort_mode {self.sort_mode!r}")
+        if self.sort_mode == "stable2" and self.compact_slots is not None \
+                and self.compact_slots != 128:
+            # Mosaic requires the last block dim divisible by 128, and the
+            # lane-major layout puts SLOTS last — measured: S=120 fails at
+            # lowering ("block shape ... divisible by 8 and 128").  0 (off)
+            # is equally invalid: the position-ordered input stable2 needs
+            # only exists on the compact lane-major path.
+            raise ValueError(
+                "sort_mode='stable2' requires compact_slots=128 (the "
+                "lane-major kernel layout puts slots in the 128-divisible "
+                "block dimension); leave compact_slots unset")
         if self.compact_slots:
             # Mirrors the kernel wrapper's envelope (fail at construction,
             # not mid-trace): sublane-aligned, within the pair-path bound.
@@ -167,11 +200,11 @@ class Config:
             raise ValueError(
                 f"rescue_overlong must be >= 0, got {self.rescue_overlong}")
         if self.rescue_overlong:
-            if self.sort_mode != "sort3":
+            if self.sort_mode == "segmin":
                 raise ValueError(
-                    "rescue_overlong requires sort_mode='sort3' (poison "
-                    "extraction rides the third sort key); set "
-                    "rescue_overlong=0 to use segmin")
+                    "rescue_overlong requires sort_mode='sort3' or "
+                    "'stable2' (poison extraction needs the poison segment "
+                    "position-ordered); set rescue_overlong=0 to use segmin")
         if self.rescue_slots:
             if self.backend != "xla" \
                     and self.rescue_window <= self.pallas_max_token + 1:
@@ -208,13 +241,24 @@ class Config:
     def rescue_slots(self) -> int:
         """The resolved overlong-rescue budget (see ``rescue_overlong``)."""
         if self.rescue_overlong is None:
-            return 1024 if self.sort_mode == "sort3" else 0
+            return 0 if self.sort_mode == "segmin" else 1024
         return self.rescue_overlong
 
     @property
     def resolved_compact_slots(self) -> int:
-        """The resolved slot-compaction budget (see ``compact_slots``)."""
-        return 88 if self.compact_slots is None else self.compact_slots
+        """The resolved slot-compaction budget (see ``compact_slots``):
+        88 per 256-byte window, or 128 per 384-byte window under stable2's
+        lane-major geometry (both measured spill-free, tools/density.py)."""
+        if self.compact_slots is not None:
+            return self.compact_slots
+        return 128 if self.sort_mode == "stable2" else 88
+
+    @property
+    def resolved_block_rows(self) -> int | None:
+        """Kernel window height in byte rows: 384 under stable2 (so the
+        transposed output block is a tile-aligned (128, 128) store), else
+        the kernel's own default (None -> 256)."""
+        return 384 if self.sort_mode == "stable2" else None
 
     @property
     def pallas_min_chunk(self) -> int:

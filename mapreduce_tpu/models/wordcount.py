@@ -82,43 +82,65 @@ def apply_top_k(result: WordCountResult, k: int) -> WordCountResult:
     )
 
 
+# Seam-table capacity for the stable2 split aggregation: seam emissions are
+# bounded by ~(2W+2)/2 tokens per window * 129 windows ≈ 4.3K at W=32, so 8K
+# slots can never spill (a spill here would silently diverge from the
+# concat-path oracle).
+_SEAM_TABLE_CAP = 8192
+# Seam-deferred overlong runs per chunk are bounded by ~2 per seam window
+# (one left-truncated + one complete >W run fit in 2W+2 bytes) * 129 windows.
+_SEAM_RESCUE_SLOTS = 384
+
+
+class SeamedUpdate(NamedTuple):
+    """A per-chunk map result whose seam table has NOT been folded yet.
+
+    The streamed stable2 path defers the seam fold to the per-step running
+    merge (a three-way :func:`...ops.table.merge` — runs of <= 3 rows fold
+    in the same two sorts), saving the two dedicated (capacity + 8K)-row
+    sorts a pairwise seam merge costs per chunk.  ``batch`` carries the
+    chunk's dropped_* accounting; ``seam`` is spill-free by construction
+    (8K slots vs <= ~4.3K seam emissions)."""
+
+    batch: table_ops.CountTable
+    seam: table_ops.CountTable
+
+
 def _map_stream(chunk: jax.Array, config: Config, capacity: int,
-                pos_hi: jax.Array | int = 0) -> table_ops.CountTable:
-    """Tokenize one buffer with the configured backend and build its table."""
+                pos_hi: jax.Array | int = 0, split_seam: bool = False):
+    """Tokenize one buffer with the configured backend and build its table.
+
+    With ``split_seam`` (streamed stable2 only) the result is a
+    :class:`SeamedUpdate` whose seam table the caller folds at its next
+    merge; otherwise a single fully-folded :class:`CountTable`.
+    """
+    if split_seam and (config.sort_mode != "stable2"
+                       or config.resolved_backend() != "pallas"
+                       or not config.resolved_compact_slots):
+        raise ValueError("split_seam requires the pallas stable2 compact "
+                         "path (the only producer of a separate seam table)")
     if config.resolved_backend() == "pallas":
         from mapreduce_tpu.ops import rescue as rescue_ops
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
-        def aggregate(col, seam, overlong):
-            # One aggregation over column + seam emissions together: the
-            # seam rows are ~8.5K entries, absorbed by the big sort for
-            # free, where a separate seam table + merge cost a second
-            # (fixed-overhead-bound) reduce pass per chunk.
-            stream = pallas_tok.concat_streams(col, seam)
-            built = table_ops.from_stream(
-                stream, capacity, pos_hi=pos_hi,
-                max_token_bytes=config.pallas_max_token,
-                max_pos=int(chunk.shape[0]), sort_mode=config.sort_mode,
-                rescue_slots=config.rescue_slots)
+        def accounted(t, n_over):
+            # ``n_over`` counts occurrences.  For dropped_count
+            # (occurrences) that is exact; for dropped_uniques it is the
+            # only available upper bound — unrescued overlong tokens
+            # leave the device unhashed, so their distinct words cannot
+            # be deduplicated.
+            return t._replace(dropped_uniques=t.dropped_uniques + n_over,
+                              dropped_count=t.dropped_count + n_over)
 
-            def accounted(t, n_over):
-                # ``n_over`` counts occurrences.  For dropped_count
-                # (occurrences) that is exact; for dropped_uniques it is the
-                # only available upper bound — unrescued overlong tokens
-                # leave the device unhashed, so their distinct words cannot
-                # be deduplicated.
-                return t._replace(dropped_uniques=t.dropped_uniques + n_over,
-                                  dropped_count=t.dropped_count + n_over)
-
-            if not config.rescue_slots:
-                return accounted(built, overlong)
-            t, rescue_packed = built
+        def rescued_table(t, rescue_packed, overlong):
+            """cond(overlong > 0): exact re-hash of the poison positions
+            (ops/rescue.py) — rescued tokens join the batch table with
+            true keys/lengths/first occurrences; only the residual stays
+            in dropped accounting.  Overlong-free chunks (both bench
+            corpora, all of test.txt) skip the windows/re-hash/merge
+            entirely."""
 
             def with_rescue(_):
-                # Exact re-hash of the poison positions (ops/rescue.py):
-                # rescued tokens join the batch table with true keys/
-                # lengths/first occurrences; only the residual stays in
-                # dropped accounting.
                 rt, rescued = rescue_ops.rescue_table(
                     chunk, rescue_packed, config.pallas_max_token,
                     config.rescue_window, pos_hi)
@@ -130,15 +152,85 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 return accounted(table_ops.merge(t, rt, capacity=capacity),
                                  residual)
 
-            # Overlong-free chunks (both bench corpora, all of test.txt)
-            # skip the windows/re-hash/merge entirely.
             return jax.lax.cond(overlong > 0, with_rescue,
                                 lambda _: accounted(t, overlong), None)
+
+        # The spill-fallback / non-compact aggregation must not use stable2:
+        # pair-layout streams are NOT position-ordered (rows interleave
+        # lanes), so first-occurrence recovery needs the third sort key.
+        concat_sort_mode = "sort3" if config.sort_mode == "stable2" \
+            else config.sort_mode
+
+        def aggregate(col, seam, overlong):
+            # One aggregation over column + seam emissions together: the
+            # seam rows are ~8.5K entries, absorbed by the big sort for
+            # free, where a separate seam table + merge cost a second
+            # (fixed-overhead-bound) reduce pass per chunk.
+            stream = pallas_tok.concat_streams(col, seam)
+            built = table_ops.from_stream(
+                stream, capacity, pos_hi=pos_hi,
+                max_token_bytes=config.pallas_max_token,
+                max_pos=int(chunk.shape[0]), sort_mode=concat_sort_mode,
+                rescue_slots=config.rescue_slots)
+            if not config.rescue_slots:
+                return accounted(built, overlong)
+            t, rescue_packed = built
+            return rescued_table(t, rescue_packed, overlong)
+
+        def aggregate_stable2(col, seam, overlong):
+            """Split aggregation for the lane-major layout: the column
+            stream keeps its position order into a STABLE 2-key sort
+            (first occurrence from tie order — the third comparator key,
+            ~40% of the sort's compute, is gone), while the tiny seam
+            stream builds its own table and folds in with one pairwise
+            merge of (capacity + 8K) rows.  Kept keys/counts/positions
+            and dropped_count are bit-identical to the concat path: the
+            merge keeps each key's smallest (pos_hi, pos_lo), and the
+            kept set of a capacity-merge of capacity-builds equals the
+            kept set of one joint build (dropped keys are all larger than
+            every kept one).  Only the dropped_uniques UPPER BOUND can
+            differ under batch-capacity spill, as cross-table merges
+            always could."""
+            built = table_ops.from_stream(
+                col, capacity, pos_hi=pos_hi,
+                max_token_bytes=config.pallas_max_token,
+                max_pos=int(chunk.shape[0]), sort_mode="stable2",
+                rescue_slots=config.rescue_slots)
+            seam_tbl = table_ops.from_stream(
+                seam, min(capacity, _SEAM_TABLE_CAP), pos_hi=pos_hi)
+            if not config.rescue_slots:
+                t = accounted(built, overlong)
+            else:
+                t, col_rescue = built
+                # Seam-deferred overlong runs are not in the column planes,
+                # so their poisons cannot ride the big sort's poison
+                # segment: extract them from the (tiny) seam stream
+                # directly — count=0 rows with a real position are exactly
+                # the seam poisons — and append their windows to the
+                # rescue pass.
+                ones = jnp.uint32(0xFFFFFFFF)
+                is_sp = (seam.count == 0) \
+                    & (seam.pos != jnp.uint32(constants.POS_INF))
+                sp = jnp.where(is_sp, seam.pos << 6, ones)
+                sp = jax.lax.sort(sp)[:_SEAM_RESCUE_SLOTS]
+                t = rescued_table(t, jnp.concatenate([col_rescue, sp]),
+                                  overlong)
+            if split_seam:
+                return SeamedUpdate(batch=t, seam=seam_tbl)
+            return table_ops.merge(t, seam_tbl, capacity=capacity)
 
         def full_path(_):
             col, seam, overlong = pallas_tok.tokenize_split(
                 chunk, max_token_bytes=config.pallas_max_token)
-            return aggregate(col, seam, overlong)
+            t = aggregate(col, seam, overlong)
+            if split_seam:
+                # Match the split branch's pytree: the fallback has already
+                # folded its seam rows, so an empty seam table rides along
+                # (inert in the caller's three-way merge).
+                return SeamedUpdate(
+                    batch=t,
+                    seam=table_ops.empty(min(capacity, _SEAM_TABLE_CAP)))
+            return t
 
         if not config.resolved_compact_slots:
             return full_path(None)
@@ -150,12 +242,15 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
         # so ANY input stays exact (the compact branch is bit-identical
         # when it runs; tools/density.py: the default budget never spills
         # on the bench corpora).
+        lane_major = config.sort_mode == "stable2"
         col, seam, overlong, spill = pallas_tok.tokenize_split_compact(
             chunk, config.resolved_compact_slots,
-            max_token_bytes=config.pallas_max_token)
+            max_token_bytes=config.pallas_max_token,
+            block_rows=config.resolved_block_rows, lane_major=lane_major)
         return jax.lax.cond(
             spill == 0,
-            lambda _: aggregate(col, seam, overlong),
+            (lambda _: aggregate_stable2(col, seam, overlong)) if lane_major
+            else (lambda _: aggregate(col, seam, overlong)),
             full_path,
             None)
     stream = tok_ops.tokenize(chunk)
@@ -296,8 +391,18 @@ class WordCountJob:
         return self._with_empty_pending(table_ops.empty(self.capacity),
                                         self.merge_every * self.batch_capacity)
 
-    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
-        return _map_stream(chunk, self.config, self.batch_capacity, pos_hi=chunk_id)
+    def _split_seam(self) -> bool:
+        """Streamed stable2 defers the per-chunk seam fold to the per-step
+        THREE-WAY running merge (merge_every == 1 only: the pending-buffer
+        staging path folds whole batch tables and has no third slot)."""
+        return (self.merge_every == 1
+                and self.config.sort_mode == "stable2"
+                and self.config.resolved_backend() == "pallas"
+                and bool(self.config.resolved_compact_slots))
+
+    def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array):
+        return _map_stream(chunk, self.config, self.batch_capacity,
+                           pos_hi=chunk_id, split_seam=self._split_seam())
 
     def _flushed(self, st: BufferedTableState) -> BufferedTableState:
         """Fold all staged batches into the table (no-op when none staged)."""
@@ -308,6 +413,12 @@ class WordCountJob:
 
     def combine(self, state, update):
         if self.merge_every == 1:
+            if isinstance(update, SeamedUpdate):
+                # Three-way fold: batch + seam ride the running merge's two
+                # sorts together (runs of <= 3 rows; see table_ops.merge).
+                return table_ops.merge(state, update.batch,
+                                       capacity=self.capacity,
+                                       c=update.seam)
             return table_ops.merge(state, update, capacity=self.capacity)
         b = self.batch_capacity
         off = ((state.cursor % jnp.uint32(self.merge_every))
@@ -669,16 +780,27 @@ class _SketchComposedJob:
                                   z, jnp.array(z), jnp.array(z),
                                   jnp.zeros((), jnp.uint32))
 
+    @staticmethod
+    def _folded(update):
+        """Fold a SeamedUpdate before sketching: the sketch updates from
+        the per-chunk batch table, so a deferred seam table would silently
+        drop seam-first words from the HLL/CMS envelope.  Sketched runs
+        pay the pairwise seam merge the plain path optimized away."""
+        if isinstance(update, SeamedUpdate):
+            return table_ops.merge(update.batch, update.seam,
+                                   capacity=update.batch.capacity)
+        return update
+
     def map_chunk(self, chunk, chunk_id) -> table_ops.CountTable:
-        return self.base.map_chunk(chunk, chunk_id)
+        return self._folded(self.base.map_chunk(chunk, chunk_id))
 
     def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
         """Forward the base job's axis-aware map (n-grams' exact seam
         machinery) so sketch composition doesn't silently disable it."""
         fn = getattr(self.base, "map_chunk_sharded", None)
         if fn is not None:
-            return fn(chunk, chunk_id, axis, device_index)
-        return self.base.map_chunk(chunk, chunk_id)
+            return self._folded(fn(chunk, chunk_id, axis, device_index))
+        return self._folded(self.base.map_chunk(chunk, chunk_id))
 
     def on_input_boundary(self, state):
         """Forward the base job's file-boundary hook (n-gram carry reset)."""

@@ -110,8 +110,8 @@ def grams_from_sorted(key_hi: jax.Array, key_lo: jax.Array,
         # Order-sensitive pairing, bit-identical to the XLA path.
         g_hi = tok_ops._fmix32(p_hi * jnp.uint32(constants.HASH_BASE_1) ^ key_hi)
         g_lo = tok_ops._fmix32(p_lo * jnp.uint32(constants.HASH_BASE_2) ^ key_lo)
-        at_sentinel = (g_hi == sentinel) & (g_lo == sentinel)
-        g_lo = jnp.where(at_sentinel, g_lo - one, g_lo)
+        at_sentinel = (g_hi == sentinel) & (g_lo >= sentinel - one)
+        g_lo = jnp.where(at_sentinel, sentinel - jnp.uint32(2), g_lo)
         g_pos = p_pos
 
     length = jnp.where(g_valid, end - g_pos, jnp.uint32(0))
@@ -325,8 +325,8 @@ def seam_gram_rows(prefix: GramCarry, first: GramCarry, n: int):
                 g_hi * jnp.uint32(constants.HASH_BASE_1) ^ src.key_hi[i])
             g_lo = tok_ops._fmix32(
                 g_lo * jnp.uint32(constants.HASH_BASE_2) ^ src.key_lo[i])
-            at_sent = (g_hi == sentinel) & (g_lo == sentinel)
-            g_lo = jnp.where(at_sent, g_lo - one, g_lo)
+            at_sent = (g_hi == sentinel) & (g_lo >= sentinel - one)
+            g_lo = jnp.where(at_sent, sentinel - jnp.uint32(2), g_lo)
         counted = occupied & all_tok
         dropped = dropped + (occupied & ~all_tok).astype(jnp.uint32)
         rows_hi.append(jnp.where(counted, g_hi, sentinel))

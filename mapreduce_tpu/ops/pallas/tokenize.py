@@ -167,7 +167,7 @@ def _compact_planes(khi, klo, packed, has, slots: int):
 
 def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
                      *refs, w: int, block_rows: int, data_rows: int,
-                     compact_slots: int = 0):
+                     compact_slots: int = 0, lane_major: bool = False):
     """One grid step: emit pair-compacted (key_hi, key_lo, packed) planes.
 
     Logical output row t of block i describes byte-row ``m = i*block_rows +
@@ -256,11 +256,20 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
     khi = _fmix32(h1 ^ ln)
     klo = _fmix32(h2 + jnp.uint32(0x9E3779B9) * ln)
     sent = jnp.uint32(constants.SENTINEL_KEY)
-    at_sent = (khi == sent) & (klo == sent)
-    klo = jnp.where(at_sent, klo - jnp.uint32(1), klo)
+    # Clamp real keys off BOTH reserved values — (sent, sent) dead filler,
+    # (sent, sent-1) poison — to (sent, sent-2); the same rule as the XLA
+    # backend's tokenize (bit-identity contract).
+    at_sent = (khi == sent) & (klo >= sent - jnp.uint32(1))
+    klo = jnp.where(at_sent, sent - jnp.uint32(2), klo)
 
     khi = jnp.where(emit, khi, sent)
-    klo = jnp.where(emit, klo, sent)
+    # Poison rows carry the reserved key (sent, sent-1): they sort into
+    # their OWN segment immediately before the dead-filler segment, so the
+    # rescue extraction can find them with a binary search even when the
+    # aggregation sort carries no third key to order the filler behind them
+    # (sort_mode='stable2').
+    klo = jnp.where(emit, klo,
+                    jnp.where(overlong_here, sent - jnp.uint32(1), sent))
     ln_e = jnp.where(emit, ln, jnp.uint32(0))
     ntok_ref[0, 0] = ntok_ref[0, 0] + jnp.sum(emit.astype(jnp.int32)).astype(jnp.uint32)
 
@@ -298,9 +307,21 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
         has_h = live[:, 0, :] | live[:, 1, :]
         khi_c, klo_c, pck_c, n_spill = _compact_planes(
             khi_h, klo_h, packed_h, has_h, compact_slots)
-        khi_ref[:] = khi_c
-        klo_ref[:] = klo_c
-        packed_ref[:] = pck_c
+        if lane_major:
+            # Transposed (LANES, S) output blocks laid side by side give a
+            # flattened stream in GLOBAL BYTE-POSITION order (lane j owns
+            # the contiguous segment [j*L, (j+1)*L); within a lane, windows
+            # and slots ascend with position) — the precondition for
+            # sort_mode='stable2' recovering first occurrence from sort
+            # stability alone.  At S=128 the transposed block is a fully
+            # tile-aligned (128, 128) store.
+            khi_ref[:] = khi_c.T
+            klo_ref[:] = klo_c.T
+            packed_ref[:] = pck_c.T
+        else:
+            khi_ref[:] = khi_c
+            klo_ref[:] = klo_c
+            packed_ref[:] = pck_c
         spill_ref[0, 0] = spill_ref[0, 0] + n_spill
     else:
         khi_ref[:] = khi_h
@@ -309,30 +330,43 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
 
 
 def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
-                 data_rows: int, interpret: bool, compact_slots: int = 0):
+                 data_rows: int, interpret: bool, compact_slots: int = 0,
+                 lane_major: bool = False):
     """Run the kernel over the (rows, 128) column view (one trailing pad block).
 
     Returns pair-compacted planes of rows//2 output rows — or, with
     ``compact_slots`` = S > 0, slot-compacted planes of rows/block_rows*S
     output rows plus a spill count (live rows beyond any lane's budget) —
     as (key_hi, key_lo, packed), plus the (overlong, token_count, spill)
-    scalars (spill is 0 on the pair path).
+    scalars (spill is 0 on the pair path).  With ``lane_major`` (compact
+    mode only) the planes are (LANES, grid*S) transposed blocks whose
+    row-major flattening is global byte-position order.
     """
     rows = cols_padded.shape[0]
     grid = rows // block_rows
     kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
-                             data_rows=data_rows, compact_slots=compact_slots)
+                             data_rows=data_rows, compact_slots=compact_slots,
+                             lane_major=lane_major)
     out_rows = grid * compact_slots if compact_slots else rows // 2
     block_out = compact_slots if compact_slots else block_rows // 2
-    out32 = jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
+    if lane_major:
+        out32 = jax.ShapeDtypeStruct((LANES, out_rows), jnp.uint32)
+        plane_spec = pl.BlockSpec((LANES, block_out), lambda i: (0, i),
+                                  memory_space=pltpu.VMEM)
+    else:
+        out32 = jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
+        plane_spec = pl.BlockSpec((block_out, LANES), lambda i: (i, 0),
+                                  memory_space=pltpu.VMEM)
     scalar = jax.ShapeDtypeStruct((1, 1), jnp.uint32)
     n_scalars = 3 if compact_slots else 2
-    # The slot-compaction mode's per-slot one-hot selects need ~31 MB of
-    # scoped VMEM at S=88 — over Mosaic's 16 MB default stack budget but
-    # comfortably inside v5e's ~128 MB physical VMEM (measured on-chip:
-    # the default limit rejects the kernel with a vmem-stack OOM at
-    # compile time; 64 MB compiles).  The pair path stays well under the
-    # default; one shared limit keeps the call site single-owner.
+    # Compact mode needs scoped VMEM above Mosaic's 16 MB default stack
+    # budget (measured on-chip: the default limit rejects it with a
+    # vmem-stack OOM at compile time; 64 MB compiles).  The limit predates
+    # the log-shift rewrite — whether the smaller-footprint kernel now fits
+    # the default is an open on-chip re-measurement (ADVICE r4); v5e has
+    # ~128 MB physical VMEM, so the override is safe headroom either way.
+    # The pair path stays well under the default; one shared limit keeps
+    # the call site single-owner.
     params = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024) \
         if compact_slots else None
     outs = pl.pallas_call(
@@ -341,8 +375,7 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
         out_shape=[out32, out32, out32] + [scalar] * n_scalars,
-        out_specs=[pl.BlockSpec((block_out, LANES), lambda i: (i, 0),
-                                memory_space=pltpu.VMEM)] * 3
+        out_specs=[plane_spec] * 3
         + [pl.BlockSpec((1, 1), lambda i: (0, 0),
                         memory_space=pltpu.SMEM)] * n_scalars,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
@@ -401,15 +434,17 @@ def _seam_pass(data: jax.Array, seg_len: int, w: int,
     sent = jnp.uint32(constants.SENTINEL_KEY)
     global_start = (starts[:, None] - (w + 1) + wstart).astype(jnp.int32)
     # Poison rows mirror the kernel's: the overlong run's LAST byte position,
-    # zero length, sentinel key, count 0.  They ride the `pos` plane (count=0
-    # rows are inert everywhere else) so concat_streams can pack them for
-    # position-ordered consumers.
+    # zero length, the reserved poison key (sent, sent-1), count 0.  They
+    # ride the `pos` plane (count=0 rows are inert everywhere else) so
+    # concat_streams can pack them for position-ordered consumers.
     global_end = (starts[:, None] - (w + 1) + wpos_end).astype(jnp.int32)
     pos = jnp.where(emit, global_start, jnp.where(is_overlong, global_end,
                                                   jnp.int32(-1)))
     stream = TokenStream(
         key_hi=jnp.where(emit, streams.key_hi, sent).reshape(-1),
-        key_lo=jnp.where(emit, streams.key_lo, sent).reshape(-1),
+        key_lo=jnp.where(emit, streams.key_lo,
+                         jnp.where(is_overlong, sent - jnp.uint32(1),
+                                   sent)).reshape(-1),
         count=jnp.where(emit, jnp.uint32(1), jnp.uint32(0)).reshape(-1),
         pos=jnp.where(pos >= 0, pos.astype(jnp.uint32)
                       + jnp.asarray(base_offset, jnp.uint32),
@@ -453,7 +488,8 @@ def tokenize_split_compact(data: jax.Array, compact_slots: int,
                            base_offset: jax.Array | int = 0,
                            max_token_bytes: int = DEFAULT_MAX_TOKEN,
                            block_rows: int | None = None,
-                           interpret: bool | None = None
+                           interpret: bool | None = None,
+                           lane_major: bool = False
                            ) -> tuple[PackedTokenStream, TokenStream,
                                       jax.Array, jax.Array]:
     """:func:`tokenize_split` with slot-compacted column planes: returns
@@ -471,15 +507,23 @@ def tokenize_split_compact(data: jax.Array, compact_slots: int,
     corpus (observed max 77, Zipf) — the fallback is for adversarial text
     (e.g. runs of single-letter tokens at density > 0.34), which stays
     exact at ~2x the chunk cost.
+
+    ``lane_major`` writes the column planes transposed so the flattened
+    col_stream is in GLOBAL BYTE-POSITION order — the input contract of
+    ``sort_mode='stable2'`` aggregation (first occurrence recovered from
+    sort stability instead of a third comparator key).  The row SET is
+    identical either way; only the order changes.
     """
     if compact_slots <= 0:
         raise ValueError(f"compact_slots must be > 0, got {compact_slots}")
     return _tokenize_split_impl(data, base_offset, max_token_bytes,
-                                block_rows, interpret, compact_slots)
+                                block_rows, interpret, compact_slots,
+                                lane_major)
 
 
 def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
-                         interpret, compact_slots: int):
+                         interpret, compact_slots: int,
+                         lane_major: bool = False):
     if interpret is None:
         # Mosaic only targets TPU; elsewhere (CPU tests, debugging) the
         # interpreter executes the same kernel semantics.
@@ -531,7 +575,7 @@ def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
 
     khi, klo, packed, over_cols, n_tokens, spill = _column_pass(
         cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
-        compact_slots=compact_slots)
+        compact_slots=compact_slots, lane_major=lane_major)
 
     # The kernel already pair-compacted and packed (start << 6 | len) in
     # VMEM (see _tokenize_kernel); reconstruct the TokenStream view lazily —

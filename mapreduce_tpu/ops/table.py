@@ -165,19 +165,20 @@ def _segment_heads(seg: jax.Array, capacity: int) -> jax.Array:
     return hi
 
 
-def _first_sentinel_row(key_hi, key_lo) -> jax.Array:
-    """Index of the first sorted row carrying the all-ones sentinel key
+def _first_key_geq(key_hi, key_lo, q_hi, q_lo) -> jax.Array:
+    """Index of the first sorted row with 64-bit key >= (q_hi, q_lo)
     (``n`` if none) — an unrolled binary search over the two key lanes,
     the :func:`_segment_heads` idiom (searchsorted's while-loop lowering
     is the expensive path on TPU)."""
     n = key_hi.shape[0]
-    sent = jnp.uint32(constants.SENTINEL_KEY)
+    q_hi = jnp.uint32(q_hi)
+    q_lo = jnp.uint32(q_lo)
     lo = jnp.int32(0)
     hi = jnp.int32(n)
     for _ in range(max(1, n.bit_length())):
         mid = (lo + hi) >> 1
         m = jnp.minimum(mid, n - 1)
-        below = (key_hi[m] < sent) | ((key_hi[m] == sent) & (key_lo[m] < sent))
+        below = (key_hi[m] < q_hi) | ((key_hi[m] == q_hi) & (key_lo[m] < q_lo))
         lo = jnp.where(below, mid + 1, lo)
         hi = jnp.where(below, hi, mid)
     return hi
@@ -194,12 +195,19 @@ def _segment_boundaries(key_hi, key_lo):
 
 
 def _overflow_accounting(sorted_key_hi, sorted_key_lo, seg, capacity: int):
-    """dropped_uniques for segments past capacity.  The sentinel segment (if
-    any) sorts last — real keys are clamped below the all-ones sentinel — so
-    it is excluded by construction."""
+    """dropped_uniques for real segments past capacity.  The two RESERVED
+    pseudo-segments — overlong-poison markers (sent, sent-1), then dead
+    filler (sent, sent) — sort last (real keys are clamped below both by
+    every tokenizer backend) and are excluded via two log-n binary
+    searches."""
     sent = jnp.uint32(constants.SENTINEL_KEY)
-    has_sentinel = (sorted_key_hi[-1] == sent) & (sorted_key_lo[-1] == sent)
-    n_real = (seg[-1] + 1).astype(jnp.uint32) - has_sentinel.astype(jnp.uint32)
+    n = sorted_key_hi.shape[0]
+    s_poison = _first_key_geq(sorted_key_hi, sorted_key_lo,
+                              sent, sent - jnp.uint32(1))
+    s_filler = _first_key_geq(sorted_key_hi, sorted_key_lo, sent, sent)
+    has_poison = (s_poison < s_filler).astype(jnp.uint32)
+    has_filler = (s_filler < n).astype(jnp.uint32)
+    n_real = (seg[-1] + 1).astype(jnp.uint32) - has_filler - has_poison
     cap = jnp.uint32(capacity)
     return jnp.where(n_real > cap, n_real - cap, jnp.uint32(0))
 
@@ -243,7 +251,7 @@ def _reduce_sorted_rows(key_hi, key_lo, pos_hi, pos_lo, count, count_hi,
         prefix(csum, head[:capacity]), prefix(csum_hi, head[:capacity]))
     key_hi_u, key_lo_u = key_hi[fi], key_lo[fi]
     occupied = (head[:capacity] < n) & ((count_u | count_hi_u) > 0) \
-        & ~((key_hi_u == sent) & (key_lo_u == sent))
+        & ~((key_hi_u == sent) & (key_lo_u >= sent - jnp.uint32(1)))
 
     count_u = jnp.where(occupied, count_u, jnp.uint32(0))
     count_hi_u = jnp.where(occupied, count_hi_u, jnp.uint32(0))
@@ -300,40 +308,53 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
          rank-range differences, and per-key fields as capacity-sized gathers
          at the segment heads.
 
-    ``sort_mode='segmin'`` replaces step 1's three-key comparator with a
-    two-key sort (``packed`` rides as payload, arbitrary order within a
-    segment) and recovers each key's first occurrence as a segmented
-    running-min of ``packed`` instead — min(pos << bits | len) is the
-    smallest pos since equal keys share a length.  The stream sort is the
-    single-chip floor (25-85 ms of the ~102 ms chunk budget, BENCHMARKS.md),
-    so shaving a comparator lane matters if the scan is cheaper than the
-    third key; both modes are bit-identical, tools/sortbench.py decides.
+    ``sort_mode='stable2'`` drops the third comparator key entirely: a
+    STABLE two-key sort with ``packed`` as payload.  Its precondition is
+    that the caller's rows arrive in ascending position order (the
+    lane-major kernel layout, or the XLA backend's per-byte streams):
+    stability then guarantees each segment's head row is the earliest
+    input row = the smallest position — first occurrence for free.  The
+    round-4 sortbench measured the comparator-width cut at ~40% of the
+    sort's compute (173.8 -> 143.2 ms on 16.8M rows, stability +1.2%)
+    where the stream sort is the single-chip floor; sort3 remains for
+    slot-major streams, which are NOT position-ordered.
 
-    With ``rescue_slots = R > 0`` (sort3 mode only), also returns the first
-    R ``packed`` values of the sorted sentinel-key segment — the overlong
-    POISON rows (``pos << len_bits`` with zero length bits) in ascending
-    position order, padded with all-ones filler.  The overlong-rescue pass
-    (:mod:`mapreduce_tpu.ops.rescue`) re-tokenizes windows at exactly these
-    positions; riding the aggregation sort makes the extraction ~free (one
-    log-n binary search plus an R-row slice), where any standalone
-    compaction would cost a second stream-sized sort or scatter.  Returns
-    ``(table, rescue_packed)`` then; segmin cannot order the sentinel
-    segment (packed rides as payload there), so the combination is
-    rejected.
+    ``sort_mode='segmin'`` also sorts two keys but recovers first
+    occurrence as a segmented running-min of ``packed`` (no input-order
+    precondition).  Bit-identical; REFUSED on TPU — its stream-sized
+    associative_scan wedges the chip (BENCHMARKS.md round 4).
+
+    With ``rescue_slots = R > 0`` (sort3/stable2), also returns the first
+    R ``packed`` values of the POISON segment — overlong-end markers
+    (``pos << len_bits`` with zero length bits) carrying the reserved key
+    (sent, sent-1), which sorts immediately before the dead-filler
+    segment.  Under sort3 the third key orders them by position; under
+    stable2 position-ordered input does.  The overlong-rescue pass
+    (:mod:`mapreduce_tpu.ops.rescue`) re-tokenizes windows at exactly
+    these positions; riding the aggregation sort makes the extraction
+    ~free (one log-n binary search plus an R-row slice), where any
+    standalone compaction would cost a second stream-sized sort or
+    scatter.  Returns ``(table, rescue_packed)`` then; segmin cannot
+    order the poison segment (packed rides as payload in arbitrary
+    order), so that combination is rejected.
 
     Matches :func:`_build` output bit-for-bit under its preconditions (every
     live row has count 1, one shared pos_hi).
     """
-    if rescue_slots and sort_mode != "sort3":
-        raise ValueError("rescue_slots requires sort_mode='sort3' (poison "
-                         "extraction rides the third sort key)")
+    if sort_mode not in ("sort3", "stable2", "segmin"):
+        raise ValueError(f"unknown sort_mode {sort_mode!r}")
+    if rescue_slots and sort_mode == "segmin":
+        raise ValueError("rescue_slots requires sort_mode='sort3' or "
+                         "'stable2' (poison extraction needs the poison "
+                         "segment position-ordered)")
     if sort_mode == "segmin":
-        from mapreduce_tpu.config import SEGMIN_TPU_ERROR, segmin_allowed
+        from mapreduce_tpu.config import (PlatformRefusedError,
+                                          SEGMIN_TPU_ERROR, segmin_allowed)
 
         # Refuse the measured chip-wedge at trace time (the CPU A/B stays
         # alive); config.segmin_allowed owns the deliberate override.
         if jax.default_backend() == "tpu" and not segmin_allowed():
-            raise ValueError(SEGMIN_TPU_ERROR)
+            raise PlatformRefusedError(SEGMIN_TPU_ERROR)
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
     n = key_hi.shape[0]
@@ -351,6 +372,15 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
             return xb | yb, jnp.where(yb, yv, jnp.minimum(xv, yv))
 
         _, run_min = jax.lax.associative_scan(_min_combine, (boundary, packed))
+    elif sort_mode == "stable2":
+        # Stable two-key sort, packed as PAYLOAD: ties keep input order, so
+        # with position-ordered input each segment's head row carries the
+        # smallest position — the same first-occurrence invariant sort3
+        # buys with a third comparator key.
+        key_hi, key_lo, packed = jax.lax.sort(
+            (key_hi, key_lo, packed), num_keys=2, is_stable=True)
+        _, rank = _segment_boundaries(key_hi, key_lo)
+        run_min = None
     else:
         key_hi, key_lo, packed = jax.lax.sort(
             (key_hi, key_lo, packed), num_keys=3)
@@ -370,8 +400,8 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
         # restarting at boundaries).
         tail = jnp.minimum(jnp.maximum(head[1:], 1) - 1, n - 1)
         packed_u = run_min[tail]
-    occupied = (head[:capacity] < n) & ((key_hi_u != sent) | (key_lo_u != sent)) \
-        & (count_u > 0)
+    occupied = (head[:capacity] < n) & (count_u > 0) \
+        & ((key_hi_u != sent) | (key_lo_u < sent - jnp.uint32(1)))
 
     count_u = jnp.where(occupied, count_u, jnp.uint32(0))
     key_hi_u = jnp.where(occupied, key_hi_u, sent)
@@ -394,14 +424,16 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     )
     if not rescue_slots:
         return table
-    # Sentinel-segment head: poison rows sort first within it (their packed
-    # is pos << bits, far below the all-ones filler).  A slice shorter than
-    # the segment (poisons beyond R) loses only the LARGEST positions —
-    # rescue order is deterministic.  When the whole segment is shorter
-    # than R the clamped start pulls in real-key rows, whose nonzero
+    # Poison-segment head (reserved key (sent, sent-1), immediately before
+    # the dead-filler segment): poison rows are position-ordered there — by
+    # the third sort key under sort3, by input order under stable2.  A
+    # slice shorter than the segment (poisons beyond R) loses only the
+    # LARGEST positions — rescue order is deterministic.  When fewer than R
+    # poisons exist the slice runs into filler rows (all-ones packed) or,
+    # when clamped at the array end, real-key rows; both carry nonzero
     # length bits the consumer masks off.
     r = min(rescue_slots, n)
-    s0 = _first_sentinel_row(key_hi, key_lo)
+    s0 = _first_key_geq(key_hi, key_lo, sent, sent - jnp.uint32(1))
     start = jnp.minimum(s0, jnp.int32(n - r))
     rescue_packed = jax.lax.dynamic_slice(packed, (start,), (r,))
     return table, rescue_packed
@@ -462,7 +494,8 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
                   z, z, z, z)
 
 
-def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTable:
+def merge(a: CountTable, b: CountTable, capacity: int | None = None,
+          c: CountTable | None = None) -> CountTable:
     """Associative, commutative merge of two tables (the combiner).
 
     Exploits the table invariant (keys unique within each input) that a
@@ -473,17 +506,24 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     ``searchsorted`` (whose while-loop + fixed-cost device copies made the
     per-step combine the single most expensive stage on the bench chip:
     ~130 ms/chunk at 256K capacity, vs two ~5 ms sorts here).
+
+    An optional THIRD table ``c`` folds in the same two sorts (runs grow to
+    at most three rows; the fold checks one more neighbor — a few extra
+    elementwise planes, no extra sort).  The streamed stable2 path uses
+    this to fold the per-chunk seam table into the per-step running merge
+    for ~free, where a dedicated pairwise seam merge cost two extra
+    (capacity + 8K)-row sorts per chunk.
     """
-    cap = capacity if capacity is not None else max(a.capacity, b.capacity)
+    tables = [a, b] + ([c] if c is not None else [])
+    cap = capacity if capacity is not None \
+        else max(t.capacity for t in tables)
     sent = jnp.uint32(constants.SENTINEL_KEY)
     inf = jnp.uint32(constants.POS_INF)
-    cat = lambda f, g: jnp.concatenate([f, g])
+    cat = lambda f: jnp.concatenate([getattr(t, f) for t in tables])
     key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length = jax.lax.sort(
-        (cat(a.key_hi, b.key_hi), cat(a.key_lo, b.key_lo),
-         cat(a.pos_hi, b.pos_hi), cat(a.pos_lo, b.pos_lo),
-         cat(a.count, b.count), cat(a.count_hi, b.count_hi),
-         cat(a.length, b.length)),
-        num_keys=4,  # (key, pos): the head row of a pair carries first occurrence
+        (cat("key_hi"), cat("key_lo"), cat("pos_hi"), cat("pos_lo"),
+         cat("count"), cat("count_hi"), cat("length")),
+        num_keys=4,  # (key, pos): the head row of a run carries first occurrence
     )
 
     eq_next = (key_hi[1:] == key_hi[:-1]) & (key_lo[1:] == key_lo[:-1])
@@ -499,6 +539,20 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
     folded_lo, folded_hi = add64(count, count_hi,
                                  jnp.where(has_next, next_count, jnp.uint32(0)),
                                  jnp.where(has_next, next_count_hi, jnp.uint32(0)))
+    if c is not None:
+        # Three inputs: a key can run three rows; the head also absorbs its
+        # follower's follower.  (head & has_next2) implies rows head+1 and
+        # head+2 both carry the head's key.
+        false2 = jnp.zeros((2,), jnp.bool_)
+        zero2 = jnp.zeros((2,), jnp.uint32)
+        eq_next2 = eq_next[1:] & eq_next[:-1]  # row i == i+1 == i+2
+        has_next2 = jnp.concatenate([eq_next2, false2])
+        folded_lo, folded_hi = add64(
+            folded_lo, folded_hi,
+            jnp.where(has_next2, jnp.concatenate([count[2:], zero2]),
+                      jnp.uint32(0)),
+            jnp.where(has_next2, jnp.concatenate([count_hi[2:], zero2]),
+                      jnp.uint32(0)))
     count_m = jnp.where(head, folded_lo, jnp.uint32(0))
     count_hi_m = jnp.where(head, folded_hi, jnp.uint32(0))
     key_hi_m = jnp.where(head, key_hi, sent)
@@ -530,11 +584,16 @@ def merge(a: CountTable, b: CountTable, capacity: int | None = None) -> CountTab
                                 n_live - jnp.uint32(cap), jnp.uint32(0))
     spill_lo, spill_hi = _sub64(*sum64(count, count_hi),
                                 *sum64(kept_lo, kept_hi))
-    du_lo, du_hi = add64(a.dropped_uniques, a.dropped_uniques_hi,
-                         b.dropped_uniques, b.dropped_uniques_hi)
+    # Every input's carried dropped_* folds in — including the optional
+    # third table's (dropping c's carries would silently break occurrence
+    # conservation whenever a seam table arrives with nonzero accounting).
+    du_lo = du_hi = dc_lo = dc_hi = jnp.uint32(0)
+    for t in tables:
+        du_lo, du_hi = add64(du_lo, du_hi,
+                             t.dropped_uniques, t.dropped_uniques_hi)
+        dc_lo, dc_hi = add64(dc_lo, dc_hi,
+                             t.dropped_count, t.dropped_count_hi)
     du_lo, du_hi = add64(du_lo, du_hi, spilled_uniques, jnp.uint32(0))
-    dc_lo, dc_hi = add64(a.dropped_count, a.dropped_count_hi,
-                         b.dropped_count, b.dropped_count_hi)
     dc_lo, dc_hi = add64(dc_lo, dc_hi, spill_lo, spill_hi)
     return CountTable(
         key_hi=key_hi_s[:cap], key_lo=key_lo_s[:cap],

@@ -130,10 +130,14 @@ def tokenize(data: jax.Array, base_offset: jax.Array | int = 0) -> TokenStream:
     key_hi = _fmix32(v1 ^ length)
     key_lo = _fmix32(v2 + jnp.uint32(0x9E3779B9) * length)
 
-    # Clamp away from the sentinel (probability 2**-64 per token).
+    # Clamp away from the two reserved keys (probability 2**-63 per token):
+    # (sent, sent) marks dead rows and (sent, sent-1) marks overlong-poison
+    # rows (:mod:`..ops.pallas.tokenize`); a real token hashing onto either
+    # would be misread structurally, so both remap to (sent, sent-2).  Every
+    # backend's clamp MUST share this rule or their keys drift.
     sentinel = jnp.uint32(constants.SENTINEL_KEY)
-    at_sentinel = (key_hi == sentinel) & (key_lo == sentinel)
-    key_lo = jnp.where(at_sentinel, key_lo - one, key_lo)
+    at_sentinel = (key_hi == sentinel) & (key_lo >= sentinel - one)
+    key_lo = jnp.where(at_sentinel, sentinel - jnp.uint32(2), key_lo)
 
     # Non-token positions carry the sentinel so they sort to the end.
     key_hi = jnp.where(is_end, key_hi, sentinel)
@@ -192,8 +196,8 @@ def _extend_grams(gram: TokenStream, tokens: TokenStream) -> TokenStream:
     key_lo = _fmix32(c_lo * jnp.uint32(constants.HASH_BASE_2) ^ tokens.key_lo)
 
     sentinel = jnp.uint32(constants.SENTINEL_KEY)
-    at_sentinel = (key_hi == sentinel) & (key_lo == sentinel)
-    key_lo = jnp.where(at_sentinel, key_lo - jnp.uint32(1), key_lo)
+    at_sentinel = (key_hi == sentinel) & (key_lo >= sentinel - jnp.uint32(1))
+    key_lo = jnp.where(at_sentinel, sentinel - jnp.uint32(2), key_lo)
 
     # Span = first byte of the gram's first token .. last byte of the current
     # token (separator bytes in between included), so host string recovery

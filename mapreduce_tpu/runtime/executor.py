@@ -70,23 +70,40 @@ def _drive_stream(engine, job, config: Config, path, state,
                   hooks: _StreamHooks, *, start_step: int, start_offset: int,
                   end_offset, bases_list: list, checkpoint_path,
                   checkpoint_every: int, fingerprint, resumed_file,
-                  logger, progress_every: int):
+                  logger, progress_every: int, timer=None):
     """The shared streaming loop: reader -> prefetch -> superstep groups ->
     engine dispatch, with checkpoint cadence and file-boundary hooks.
     Returns ``(state, bytes_done, step_index)``; ``bytes_done`` is the
-    absolute stream cursor (starts at ``start_offset``)."""
+    absolute stream cursor (starts at ``start_offset``).
+
+    ``timer`` (a :class:`...runtime.metrics.PhaseTimer`) decomposes the
+    stream wall-clock into the phases the ingest number is made of
+    (VERDICT r4 next #2 — without this the 3x streamed-vs-H2D gap was
+    unattributable): ``read_wait`` (blocking on the prefetching reader),
+    ``stage`` (host assembly + host->device placement of a group),
+    ``dispatch`` (program enqueue; under async dispatch this blocks only
+    when the device queue is full, so a large value means compute-bound,
+    a small one link/host-bound).
+    """
     bytes_done = int(start_offset)
     step_index = start_step
     last_ckpt = start_step // checkpoint_every if checkpoint_every else 0
     k = config.superstep
     pending: list = []
+    timer = timer if timer is not None else metrics_mod.PhaseTimer()
 
     def dispatch(state, group):
-        if len(group) == 1:
-            return engine.step(state, hooks.stage_single(group[0]),
-                               group[0].step)
-        return engine.step_many(state, hooks.stage_group(group),
-                                group[0].step)
+        timer.start("stage")
+        staged = hooks.stage_single(group[0]) if len(group) == 1 \
+            else hooks.stage_group(group)
+        timer.stop("stage")
+        timer.start("dispatch")
+        try:
+            if len(group) == 1:
+                return engine.step(state, staged, group[0].step)
+            return engine.step_many(state, staged, group[0].step)
+        finally:
+            timer.stop("dispatch")
 
     def split_at_checkpoints(group):
         """Cut a superstep group at checkpoint boundaries, so resume
@@ -188,13 +205,21 @@ def _drive_stream(engine, job, config: Config, path, state,
     # after resume silently skipped the reset and leaked grep's line carry).
     last_file: Optional[int] = resumed_file
     # Prefetch: host-side chunking of step N+1 overlaps device compute of
-    # step N (the double-buffering of SURVEY §7 step 4).
-    for batch in reader_mod.prefetch(
-            reader_mod.iter_batches_multi(path, engine.n_devices,
-                                          config.chunk_bytes,
-                                          start_offset=start_offset,
-                                          start_step=start_step,
-                                          end_offset=end_offset)):
+    # step N (the double-buffering of SURVEY §7 step 4).  The manual
+    # iterator lets read_wait be timed: time spent HERE is the reader
+    # failing to keep ahead of the device.
+    it = iter(reader_mod.prefetch(
+        reader_mod.iter_batches_multi(path, engine.n_devices,
+                                      config.chunk_bytes,
+                                      start_offset=start_offset,
+                                      start_step=start_step,
+                                      end_offset=end_offset)))
+    while True:
+        timer.start("read_wait")
+        batch = next(it, None)
+        timer.stop("read_wait")
+        if batch is None:
+            break
         if (boundary_hook is not None and last_file is not None
                 and batch.file_index != last_file):
             if pending:
@@ -293,9 +318,14 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         state = engine.init_states()
         resumed_file = None
 
+    # Staging is explicit (device_put with the engine's sharding) so the
+    # phase decomposition attributes host->device placement to "stage"
+    # rather than folding it into the step dispatch; Engine.step's own
+    # device_put then sees already-placed arrays (a no-op).
     hooks = _StreamHooks(
-        stage_single=lambda b: b.data,
-        stage_group=lambda g: np.stack([b.data for b in g], axis=1),
+        stage_single=lambda b: jax.device_put(b.data, engine.sharding),
+        stage_group=lambda g: jax.device_put(
+            np.stack([b.data for b in g], axis=1), engine.sharding),
         snapshot=lambda s: jax.tree.map(np.asarray, s),
         restage=lambda s_np: jax.device_put(s_np, engine._sharded),
         write_gate=lambda: True,
@@ -307,7 +337,13 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         end_offset=range_hi, bases_list=bases_list,
         checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         fingerprint=fingerprint, resumed_file=resumed_file,
-        logger=logger, progress_every=progress_every)
+        logger=logger, progress_every=progress_every, timer=timer)
+    # Drain: under async dispatch the loop can run ahead of the device;
+    # blocking here splits queued compute ("drain") from enqueue time
+    # ("dispatch") and keeps the stream/reduce boundary honest.
+    timer.start("drain")
+    jax.block_until_ready(state)
+    timer.stop("drain")
     timer.stop("stream")
 
     timer.start("reduce")
@@ -421,7 +457,10 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         end_offset=None, bases_list=bases_list,
         checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         fingerprint=fingerprint, resumed_file=resumed_file,
-        logger=logger, progress_every=progress_every)
+        logger=logger, progress_every=progress_every, timer=timer)
+    timer.start("drain")
+    jax.block_until_ready(state)
+    timer.stop("drain")
     timer.stop("stream")
 
     timer.start("reduce")

@@ -72,9 +72,10 @@ def test_defaults_match_measured_decisions():
     weak #2: "production defaults ignore the round's own measurements")."""
     cfg = Config()
     assert cfg.chunk_bytes == 1 << 25  # 32 MB
-    assert cfg.resolved_compact_slots == 88
+    assert cfg.sort_mode == "stable2"  # round-5 on-chip A/B: +5.9% zipf
+    assert cfg.resolved_compact_slots == 128  # lane-major 384-byte windows
+    assert cfg.resolved_block_rows == 384
     assert cfg.merge_every == 1
-    assert cfg.sort_mode == "sort3"
     assert cfg.rescue_slots == 1024
 
     # The CLI must hand users the same measured-optimal shape with no flags.

@@ -440,3 +440,45 @@ def test_dropped_count_scalar_carries_past_2_32():
     _, dc = m.dropped_totals()
     assert dc == 0xFFFFFFF0 + 0x20  # > 2**32
     assert int(m.total_count()) == 3 + 0xFFFFFFF0 + 0x20  # x:2, y:1 live
+
+
+def test_merge_three_way_equals_pairwise(rng):
+    """merge(a, b, c=...) must fold three-row key runs exactly like two
+    pairwise merges: same kept keys, counts, first occurrences, and
+    dropped_count (dropped_uniques is a bound and may only TIGHTEN)."""
+    mk = lambda text, ph: tbl.from_stream(_stream(text), 8, pos_hi=ph)
+    a = mk(b"alpha beta gamma delta ", 0)
+    b = mk(b"beta gamma epsilon ", 1)
+    c = mk(b"alpha beta zeta eta theta ", 2)
+    # Every input carries prior dropped accounting — the 3-way fold must
+    # conserve c's too (a seam table can arrive with nonzero carries).
+    import jax.numpy as jnp
+    seed = lambda t, du, dc: t._replace(dropped_uniques=jnp.uint32(du),
+                                        dropped_count=jnp.uint32(dc))
+    a, b, c = seed(a, 1, 5), seed(b, 2, 7), seed(c, 3, 11)
+    three = tbl.merge(a, b, capacity=8, c=c)
+    pair = tbl.merge(tbl.merge(a, b, capacity=8), c, capacity=8)
+    assert int(three.dropped_count) == 5 + 7 + 11
+    for f in ("key_hi", "key_lo", "count", "count_hi", "pos_hi", "pos_lo",
+              "length", "dropped_count", "dropped_count_hi"):
+        np.testing.assert_array_equal(np.asarray(getattr(three, f)),
+                                      np.asarray(getattr(pair, f)), err_msg=f)
+    assert int(three.dropped_uniques) <= int(pair.dropped_uniques)
+
+
+def test_merge_three_way_spill_accounting():
+    """Under capacity pressure the 3-way fold keeps the smallest-cap keys
+    of the union (the same kept set as any merge order) and accounts every
+    spilled occurrence."""
+    mk = lambda text, ph: tbl.from_stream(_stream(text), 4, pos_hi=ph)
+    a = mk(b"a1 b2 c3 d4 ", 0)
+    b = mk(b"b2 e5 f6 ", 1)
+    c = mk(b"a1 g7 h8 ", 2)
+    three = tbl.merge(a, b, capacity=4, c=c)
+    pair = tbl.merge(tbl.merge(a, b, capacity=4), c, capacity=4)
+    np.testing.assert_array_equal(np.asarray(three.key_hi),
+                                  np.asarray(pair.key_hi))
+    np.testing.assert_array_equal(np.asarray(three.count),
+                                  np.asarray(pair.count))
+    # Total occurrences conserved: kept + dropped == 10 tokens.
+    assert int(three.total_count()) == 10

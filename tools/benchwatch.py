@@ -97,31 +97,34 @@ def main() -> int:
             # stacked-candidate rows need the end-to-end ingest number.
             ab = {**env, "BENCH_STREAMED": "0"}
             steps = [
+                # Mosaic lowering surprises only show on the chip (interpret
+                # validates semantics, not the target): a ~minute parity
+                # smoke of the production kernel configs runs BEFORE any
+                # bench spends the window (VERDICT r4 next #8).
+                ("kernel-smoke", [sys.executable, "tools/kernel_smoke.py"],
+                 env),
+                # Defaults row = stable2 since round 5 (+5.9% measured).
                 ("bench-zipf", [sys.executable, "bench.py"], env),
+                # Regression A/B rows: the previous default (sort3) and the
+                # uncompacted path.  segmin's stream-sized associative_scan
+                # wedges the chip (3 observations, BENCHMARKS.md round 4) —
+                # no bench row; sortbench's gated SORTBENCH_SCAN=1 path
+                # covers it off-TPU.
+                ("bench-zipf-sort3", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_SORT_MODE": "sort3"}),
+                ("bench-zipf-nocompact", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_COMPACT_SLOTS": "0",
+                  "BENCH_SORT_MODE": "sort3"}),
                 ("sortbench", [sys.executable, "tools/sortbench.py"], env),
-                # segmin's stream-sized associative_scan wedges the chip
-                # (3 observations, BENCHMARKS.md round 4) — no bench row;
-                # sortbench's gated SORTBENCH_SCAN=1 path covers it off-TPU.
                 ("bench-natural-100mb", [sys.executable, "bench.py"],
                  {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
-                ("bench-zipf-chunk64", [sys.executable, "bench.py"],
-                 {**ab, "BENCH_CHUNK_MB": "64", "BENCH_REPEATS": "4"}),
-                ("bench-zipf-merge8", [sys.executable, "bench.py"],
-                 {**ab, "BENCH_MERGE_EVERY": "8"}),
-                # compact_slots defaults ON since round 4 (+25% measured);
-                # the A/B row now measures the OFF path for regressions.
-                ("bench-zipf-nocompact", [sys.executable, "bench.py"],
-                 {**ab, "BENCH_COMPACT_SLOTS": "0"}),
                 ("bench-webby", [sys.executable, "bench.py"],
                  {**ab, "BENCH_CORPUS": "webby", "BENCH_MB": "64",
                   "BENCH_REPEATS": "4"}),
-                ("opshare-sort3", [sys.executable, "tools/opshare.py"], env),
-                ("opshare-segmin", [sys.executable, "tools/opshare.py"],
-                 {**env, "OPSHARE_SORT_MODE": "segmin"}),
-                ("opshare-merge8", [sys.executable, "tools/opshare.py"],
-                 {**env, "OPSHARE_MERGE_EVERY": "8"}),
-                ("opshare-nocompact", [sys.executable, "tools/opshare.py"],
-                 {**env, "OPSHARE_COMPACT_SLOTS": "0"}),
+                ("opshare-default", [sys.executable, "tools/opshare.py"],
+                 env),
+                ("opshare-sort3", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_SORT_MODE": "sort3"}),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""On-chip Mosaic kernel parity smoke: one tiny chunk, real kernel vs oracle.
+
+Interpret mode validates kernel *semantics* only — the VMEM-stack limit, i1
+vector-register shifts, 8-bit compares, and unsigned reductions all passed
+interpret and failed only on the chip (BENCHMARKS.md round 4, "interpret
+validates semantics, not the target").  This smoke costs ~seconds of a live
+window and catches the next Mosaic lowering surprise BEFORE a bench run
+spends the window: it compiles and runs the production kernel configs on the
+real device over a 1 MB corpus slice and bit-compares the resulting tables
+against the XLA-scan oracle.
+
+Prints ONE JSON line: {"kernel_parity_ok": bool, "modes": {...}, ...}.
+Exit 0 when every mode agrees, 1 otherwise, 3 when the device is
+unreachable.  VERDICT r4 weak #5 / next #8.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "120"))
+    if os.environ.get("BENCH_PROBE", "1") != "0":
+        from mapreduce_tpu.runtime.probe import probe_once
+
+        platform, err = probe_once(budget)
+        if platform is None or platform == "cpu":
+            print(json.dumps({"kernel_parity_ok": None,
+                              "error": f"device unreachable ({err})"}))
+            return 3
+
+    import jax
+
+    from bench import make_zipf_corpus
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.models import wordcount
+
+    t0 = time.perf_counter()
+    data = make_zipf_corpus(1 << 20)
+    # A few overlong runs so the poison/rescue path is exercised on-chip.
+    data = data[: 1 << 19] + b" " + b"u" * 40 + b" " + data[1 << 19:]
+
+    # The XLA-scan oracle runs on CPU (it compiles pathologically slowly on
+    # TPU at MB sizes — the reason the pallas path exists).
+    cpus = jax.devices("cpu")
+    with jax.default_device(cpus[0]):
+        oracle_r = wordcount.count_words(
+            data, Config(backend="xla", chunk_bytes=1 << 20,
+                         table_capacity=1 << 16))
+
+    modes = {}
+    ok = True
+    for name, cfg in {
+        "sort3_compact88": Config(backend="pallas", chunk_bytes=1 << 20,
+                                  table_capacity=1 << 16, sort_mode="sort3"),
+        "stable2_lane_major": Config(backend="pallas", chunk_bytes=1 << 20,
+                                     table_capacity=1 << 16,
+                                     sort_mode="stable2"),
+    }.items():
+        try:
+            r = wordcount.count_words(data, cfg)
+            same = (r.words == oracle_r.words and r.counts == oracle_r.counts
+                    and r.total == oracle_r.total)
+            modes[name] = "ok" if same else "MISMATCH"
+            ok = ok and same
+        except Exception as e:  # compile/runtime lowering failure
+            modes[name] = f"ERROR: {type(e).__name__}: {e}"[:300]
+            ok = False
+    print(json.dumps({
+        "kernel_parity_ok": ok,
+        "modes": modes,
+        "backend": jax.default_backend(),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,7 @@ def main() -> int:
     from mapreduce_tpu.parallel.mapreduce import Engine
     from mapreduce_tpu.parallel.mesh import data_mesh
 
-    sort_mode = os.environ.get("OPSHARE_SORT_MODE", "sort3")
+    sort_mode = os.environ.get("OPSHARE_SORT_MODE", Config.sort_mode)
     if sort_mode == "segmin" and jax.default_backend() == "tpu" \
             and os.environ.get("OPSHARE_FORCE", "0") != "1":
         # Measured 2026-07-31: the 16.8M-row segmented associative_scan
