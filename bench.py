@@ -185,6 +185,58 @@ def make_webby_corpus(n_bytes: int, seed: int = 23) -> bytes:
     return b" ".join(words)[:n_bytes]
 
 
+def make_markup_corpus(n_bytes: int, seed: int = 31) -> bytes:
+    """enwik-like markup proxy: the hostile-input stand-in (VERDICT r4
+    missing #3 — the other generators are clean ASCII).
+
+    Structured like wikipedia XML dumps: nested tags with attribute blobs,
+    ``[[wiki links|display text]]``, ``&entities;``, UTF-8 MULTIBYTE words
+    (Latin-1 accents, Greek, CJK — continuation bytes >= 0x80 must never
+    split tokens), URLs past the W=32 window, and occasional very long
+    separator-free attribute runs that exercise the reader's force-split.
+    Tokens here are what the framework's whitespace semantics see — e.g.
+    ``<title>Αθήνα</title>`` is ONE token — matching how the reference
+    would tokenize the same bytes.
+    """
+    rng = np.random.default_rng(seed)
+    latin = ["café", "naïve", "über", "résumé",
+             "Zürich", "élève"]
+    greek = ["Αθήνα", "λόγος"]
+    cjk = ["東京", "中文", "日本語"]
+    plain = _COMMON
+    ents = ["&amp;", "&lt;", "&gt;", "&quot;", "&#945;"]
+    parts, have = [], 0
+    while have < n_bytes:
+        page = ["<page>\n  <title>",
+                str(rng.choice(plain)).capitalize(),
+                "</title>\n  <revision id=\"",
+                str(int(rng.integers(1e6, 1e8))), "\">\n    <text>"]
+        for _ in range(int(rng.integers(40, 120))):
+            r = rng.random()
+            if r < 0.72:
+                page.append(str(rng.choice(plain)))
+            elif r < 0.82:
+                page.append(str(rng.choice(latin + greek + cjk)))
+            elif r < 0.88:
+                page.append("[[" + str(rng.choice(plain)) + "|"
+                            + str(rng.choice(plain)) + "]]")
+            elif r < 0.93:
+                page.append(str(rng.choice(ents)))
+            elif r < 0.97:
+                page.append("http://example.org/wiki/"
+                            + "/".join(str(rng.choice(plain))
+                                       for _ in range(int(rng.integers(2, 7)))))
+            else:  # long separator-free attribute blob (force-split fodder)
+                n = int(rng.integers(40, 400))
+                page.append("style=\"" + "a" * n + "\"")
+            page.append("\n" if rng.random() < 0.1 else " ")
+        page.append("</text>\n  </revision>\n</page>\n")
+        slab = "".join(page).encode("utf-8")
+        parts.append(slab)
+        have += len(slab)
+    return b"".join(parts)[:n_bytes].rsplit(b" ", 1)[0] + b"\n"
+
+
 def cpu_baseline_gbps(data: bytes, repeats: int = 1) -> float:
     from collections import Counter
 
@@ -333,6 +385,9 @@ def main() -> int:
     elif corpus_kind == "webby":
         corpus = make_webby_corpus(mb << 20)
         corpus_name = "synthetic-webby"
+    elif corpus_kind == "markup":
+        corpus = make_markup_corpus(mb << 20)
+        corpus_name = "synthetic-markup"
     else:
         corpus = make_zipf_corpus(mb << 20)
         corpus_name = "synthetic-zipf"

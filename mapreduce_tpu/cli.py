@@ -137,9 +137,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "chunk exactly via bounded XLA windows (URLs/markup "
                         "on natural text; default auto: 1024 under sort3, "
                         "off under segmin; 0 disables)")
+    p.add_argument("--rescue-overlong-max", type=int, default=None,
+                   metavar="R2",
+                   help="second-tier rescue budget: chunks whose overlong "
+                        "count exceeds --rescue-overlong escalate to R2 "
+                        "slots under a cond (default auto: chunk_bytes/1024 "
+                        "clamped to [R, 65536] — covers URL-dense text with "
+                        "no hand-sizing)")
     p.add_argument("--rescue-window", type=int, default=192, metavar="B",
                    help="rescue lookback bound: tokens up to B-1 bytes are "
                         "recovered exactly; longer ones stay accounted")
+    p.add_argument("--verify-sample", type=int, default=0, metavar="K",
+                   help="after a word-count run, exactly recount K reported "
+                        "words host-side (byte-string keyed, no hashing) "
+                        "and fail loudly on any mismatch — the detection "
+                        "path for the ~n^2/2^65 64-bit key-collision "
+                        "envelope (see utils/verify.py); costs one host "
+                        "pass over the corpus")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
@@ -368,6 +382,13 @@ def main(argv: list[str] | None = None) -> int:
                 parser.error(f"{flag} is not supported with {mode}")
     if args.grep is not None and args.sample is not None:
         parser.error("--grep and --sample are mutually exclusive")
+    if args.verify_sample:
+        if args.verify_sample < 0:
+            parser.error(f"--verify-sample must be >= 0, got {args.verify_sample}")
+        if args.ngram > 1 or args.grep is not None or args.sample is not None:
+            # Recounting is word-keyed; gram spans contain separators and
+            # grep/sample report no counts to check.
+            parser.error("--verify-sample applies to word-count runs only")
     if args.ngram > 1 and args.merge_every > 1:
         # Mirror NGramCountJob's refusal as a clean usage error instead of a
         # mid-run traceback (the n-gram combine is pairwise by design).
@@ -420,6 +441,7 @@ def main(argv: list[str] | None = None) -> int:
                         merge_every=args.merge_every,
                         compact_slots=args.compact_slots,
                         rescue_overlong=args.rescue_overlong,
+                        rescue_overlong_max=args.rescue_overlong_max,
                         rescue_window=args.rescue_window)
     except ValueError as e:
         parser.error(str(e))
@@ -550,6 +572,20 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.stats:
         _print_stats(input_bytes, result.total, "words", elapsed)
+
+    if args.verify_sample:
+        from mapreduce_tpu.utils.verify import verify_result
+
+        mismatches = verify_result(words, counts, paths,
+                                   sample=args.verify_sample)
+        if mismatches:
+            for w, rep, true in mismatches:
+                print(f"verify: MISMATCH {w!r}: reported {rep}, exact "
+                      f"recount {true} (possible 64-bit key collision — "
+                      "see mapreduce_tpu/utils/verify.py)", file=sys.stderr)
+            return 4
+        print(f"verify: ok ({min(args.verify_sample, len(words))} words "
+              "recounted exactly)", file=sys.stderr)
     return 0
 
 

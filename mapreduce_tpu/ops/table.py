@@ -20,6 +20,18 @@ Invariants of a well-formed table (established by every constructor here):
     ``dropped_uniques`` an upper bound), never silent corruption like the
     reference past MAX_OUTPUT_COUNT (``main.cu:103-104``).
 
+Key-collision envelope: keys are 64-bit hashes (two independent fmix32
+lanes, token length mixed in), never the token bytes — so two DISTINCT
+words colliding on all 64 bits would silently merge into one entry (first
+occurrence's identity, summed count).  Birthday arithmetic: P(any
+collision among n distinct words) ~ n^2 / 2^65 — ~3e-8 at 1e6 distinct
+(enwik8), ~3e-4 at 1e8 (the 100 GB Zipf target), ~3e-2 at 1e9
+(Common-Crawl WET scale).  Undetectable from the table alone (the table
+never sees the strings); the DETECTION path is a host-side exact recount
+of reported words (:mod:`mapreduce_tpu.utils.verify`, CLI
+``--verify-sample K``), where a collision shows as a reported count
+exceeding the byte-exact recount.
+
 Count envelope: per-key counts and the ``dropped_*`` scalars are exact
 **64-bit** values carried as uint32 lo/hi lane pairs (JAX default-x64 is
 off, so device uint64 is unavailable — the grep accumulator idiom,

@@ -64,6 +64,14 @@ class _StreamHooks:
     restage: Any  # host pytree -> sharded device state (retry; None = n/a)
     write_gate: Any  # () -> bool: this process writes checkpoint files
     retry: int = 0
+    # Optional Batch -> Batch applied the moment a batch leaves the reader:
+    # run_job uses it to device_put each [D, C] chunk array immediately
+    # (async H2D starts right away and overlaps the PREVIOUS group's
+    # compute), so superstep groups stack already-resident device arrays
+    # instead of shipping one K-times-larger host array at dispatch time —
+    # measured through the relay tunnel, a single 128 MB staged array moved
+    # ~7x slower per byte than 32 MB chunk arrays (BENCHMARKS.md round 5).
+    stage_arrival: Any = None
 
 
 def _drive_stream(engine, job, config: Config, path, state,
@@ -220,6 +228,10 @@ def _drive_stream(engine, job, config: Config, path, state,
         timer.stop("read_wait")
         if batch is None:
             break
+        if hooks.stage_arrival is not None:
+            timer.start("stage")
+            batch = hooks.stage_arrival(batch)
+            timer.stop("stage")
         if (boundary_hook is not None and last_file is not None
                 and batch.file_index != last_file):
             if pending:
@@ -318,18 +330,29 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         state = engine.init_states()
         resumed_file = None
 
-    # Staging is explicit (device_put with the engine's sharding) so the
-    # phase decomposition attributes host->device placement to "stage"
-    # rather than folding it into the step dispatch; Engine.step's own
-    # device_put then sees already-placed arrays (a no-op).
+    # Each batch is staged to the device the moment the reader hands it
+    # over (stage_arrival): the async H2D overlaps the previous group's
+    # compute, the phase decomposition attributes placement to "stage",
+    # and superstep groups stack ALREADY-RESIDENT [D, C] arrays on device
+    # — shipping one K-times-larger stacked host array at dispatch time
+    # measured ~7x slower per byte through the relay tunnel (round 5).
+    import jax.numpy as jnp
+
+    # With retry > 0 the batches must stay HOST numpy: the replay contract
+    # re-dispatches the still-alive host buffers with a FRESH H2D per
+    # attempt — an arrival-staged device array could itself be the failed
+    # (error-poisoned) object, making every retry re-raise.
     hooks = _StreamHooks(
-        stage_single=lambda b: jax.device_put(b.data, engine.sharding),
-        stage_group=lambda g: jax.device_put(
-            np.stack([b.data for b in g], axis=1), engine.sharding),
+        stage_single=lambda b: b.data,
+        stage_group=(lambda g: np.stack([b.data for b in g], axis=1))
+        if retry > 0 else
+        (lambda g: jnp.stack([b.data for b in g], axis=1)),
         snapshot=lambda s: jax.tree.map(np.asarray, s),
         restage=lambda s_np: jax.device_put(s_np, engine._sharded),
         write_gate=lambda: True,
-        retry=retry)
+        retry=retry,
+        stage_arrival=None if retry > 0 else (lambda b: dataclasses.replace(
+            b, data=jax.device_put(b.data, engine.sharding))))
     timer.start("stream")
     state, bytes_done, _ = _drive_stream(
         engine, job, config, path, state, hooks,

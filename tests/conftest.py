@@ -7,6 +7,15 @@ paths are exercised on CPU without TPUs.  Must run before any jax import.
 import os
 import sys
 
+# HARD-disable the persistent XLA compile cache for the whole suite: the
+# XLA:CPU executable serialization segfaults the process on the cache
+# WRITE (reproduced round 4 and again round 5 — the round-5 crash came via
+# test_cli running cli.main() in-process, which enabled the cache for
+# every LATER test's fresh compiles; empty MAPREDUCE_COMPILE_CACHE makes
+# enable_compile_cache a no-op).  The CLI/bench keep their cache outside
+# pytest — it is exercised mostly on TPU, where serialization is solid.
+os.environ["MAPREDUCE_COMPILE_CACHE"] = ""
+
 # The force-CPU idiom (config.update after import — env vars alone are too
 # late because sitecustomize may import jax at interpreter startup) lives in
 # one place: __graft_entry__._force_cpu_mesh.  It also bumps a too-small

@@ -213,7 +213,7 @@ def test_compact_map_stream_falls_back_exactly(rng):
 
     for data in (b"a b " * 1024,          # density 1/2: always spills
                  make_corpus(np.random.default_rng(5), 2000, 150)):
-        cfg = Config(backend="pallas", chunk_bytes=1 << 14,
+        cfg = Config(backend="pallas", chunk_bytes=1 << 14, sort_mode="sort3",
                      compact_slots=8, pallas_max_token=32)
         buf = tok.pad_to(np.frombuffer(data, np.uint8),
                          max(cfg.pallas_min_chunk,
@@ -265,9 +265,9 @@ def test_compact_overlong_accounting(rng):
 
 def test_compact_slots_validation():
     with pytest.raises(ValueError, match="compact_slots"):
-        Config(compact_slots=12)  # not a multiple of 8
+        Config(compact_slots=12, sort_mode="sort3")  # not a multiple of 8
     with pytest.raises(ValueError, match="compact_slots"):
-        Config(compact_slots=136)  # > 128
+        Config(compact_slots=136, sort_mode="sort3")  # > 128
     with pytest.raises(ValueError, match="compact_slots"):
         ptok.tokenize_split_compact(
             tok.pad_to(b"hello world", 128 * 18), 48,

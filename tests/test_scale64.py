@@ -1,0 +1,38 @@
+"""Distributed paths at D=64 virtual devices (VERDICT r4 missing #1).
+
+The 8-device conftest mesh exercises correctness of the SPMD programs, but
+SPMD *program bugs* — reshape/layout limits in ``all_to_all``, the keyrange
+budget arithmetic ``b = 2C/D``, collective scheduling — characteristically
+appear at larger D.  The driver's dryrun runs D=8; this test compiles and
+runs the same full battery (tree/hierarchical/keyrange merges, keyrange-vs-
+tree bit-identity, run_job_global staging, sketches, n-gram, grep, sample,
+pallas rescue + top-k) at D=64 in a SUBPROCESS (the session's device count
+is pinned at import time and cannot be raised in-process).
+
+D=256 is available manually:
+``MAPREDUCE_SCALE_DEVICES=256 python -m pytest tests/test_scale64.py``.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_at_64_devices():
+    n = int(os.environ.get("MAPREDUCE_SCALE_DEVICES", "64"))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # A fresh process so the virtual-device flag lands before JAX init.
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; sys.path.insert(0, {REPO!r})\n"
+         f"from __graft_entry__ import _force_cpu_mesh, dryrun_multichip\n"
+         f"jax = _force_cpu_mesh({n})\n"
+         f"assert len(jax.devices()) >= {n}, len(jax.devices())\n"
+         f"dryrun_multichip({n})\n"
+         f"print('scale-ok', {n})\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert f"scale-ok {n}" in proc.stdout

@@ -121,6 +121,9 @@ def main() -> int:
                 ("bench-webby", [sys.executable, "bench.py"],
                  {**ab, "BENCH_CORPUS": "webby", "BENCH_MB": "64",
                   "BENCH_REPEATS": "4"}),
+                ("bench-markup", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_CORPUS": "markup", "BENCH_MB": "64",
+                  "BENCH_REPEATS": "4"}),
                 ("opshare-default", [sys.executable, "tools/opshare.py"],
                  env),
                 ("opshare-sort3", [sys.executable, "tools/opshare.py"],
