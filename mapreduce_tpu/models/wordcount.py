@@ -102,10 +102,10 @@ class SeamedUpdate(NamedTuple):
 
     The streamed stable2 path defers the seam fold to the per-step running
     merge (a three-way :func:`...ops.table.merge` — runs of <= 3 rows fold
-    in the same two sorts), saving the two dedicated (capacity + 8K)-row
+    in the same two sorts), saving the two dedicated (capacity + seam)-row
     sorts a pairwise seam merge costs per chunk.  ``batch`` carries the
     chunk's dropped_* accounting; ``seam`` is spill-free by construction
-    (8K slots vs <= ~4.3K seam emissions)."""
+    (:func:`_seam_table_cap` covers the 129*(W+1) emission bound)."""
 
     batch: table_ops.CountTable
     seam: table_ops.CountTable
