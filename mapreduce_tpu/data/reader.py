@@ -251,6 +251,34 @@ def read_words_at(path: str, spans: list[tuple[int, int]]) -> list[bytes]:
     return [bytes(mm[off: off + ln]) for off, ln in spans]
 
 
+def scan_gram_lengths_bytes(source: bytes | np.ndarray, offsets,
+                            n: int) -> list[int]:
+    """In-memory :func:`scan_gram_lengths`: spans of the n-entry grams
+    starting at ``offsets`` of one whole buffer (no chunk cuts — the
+    single-buffer paths never force-split).  Used by
+    ``models.wordcount.recover_result`` for long-span gram entries
+    (length = ``SEAM_GRAM_LENGTH``, the >= 127-byte spans the packed gram
+    build cannot store).  One vectorized pass over the buffer however many
+    offsets."""
+    arr = np.frombuffer(source, dtype=np.uint8) if isinstance(source, bytes) \
+        else np.asarray(source, dtype=np.uint8)
+    if arr.shape[0] == 0:
+        return [0 for _ in offsets]
+    sep = _SEP_LUT[arr]
+    nxt = np.concatenate([sep[1:], np.array([True])])
+    epos = np.flatnonzero(~sep & nxt)  # entry end positions (inclusive)
+    offs = np.asarray(list(offsets), dtype=np.int64)
+    if len(epos) == 0:  # all-separator buffer: spans run to the end
+        return [int(arr.shape[0] - o) for o in offs]
+    # A gram that exists has n entry ends at/after its start; if the
+    # buffer ends mid-stream the remaining bytes are the span.
+    j = np.searchsorted(epos, offs) + n - 1
+    in_range = j < len(epos)
+    ends = np.where(in_range, epos[np.minimum(j, len(epos) - 1)] + 1,
+                    arr.shape[0])
+    return [int(e - o) for e, o in zip(ends, offs)]
+
+
 def scan_gram_lengths(paths, offsets, n: int,
                       cut_offsets=None) -> list[int]:
     """Byte lengths of the n-entry grams starting at virtual corpus offsets.

@@ -320,14 +320,27 @@ def _reported_distinct(tbl: table_ops.CountTable, n_words: int,
 
 
 def recover_result(tbl: table_ops.CountTable, source: bytes,
-                   estimate_distinct: bool = True) -> WordCountResult:
-    """Host-side string recovery from a single-buffer table (pos_hi == 0)."""
+                   estimate_distinct: bool = True,
+                   ngram: int = 1) -> WordCountResult:
+    """Host-side string recovery from a single-buffer table (pos_hi == 0).
+
+    ``ngram`` is the gram order of the table: entries whose length is the
+    ``SEAM_GRAM_LENGTH`` sentinel are >= 127-byte spans (the packed gram
+    build stores lengths in 7 bits) and are recovered by scanning ``ngram``
+    entries forward from the start, the cross-chunk seam idiom.
+    """
     count = np.asarray(tbl.count).astype(np.int64)
     count_hi = np.asarray(tbl.count_hi).astype(np.int64)
     valid = (count > 0) | (count_hi > 0)
     pos = np.asarray(tbl.pos_lo)[valid]
-    length = np.asarray(tbl.length)[valid]
+    length = np.asarray(tbl.length)[valid].astype(np.int64)
     cnt = (count + (count_hi << np.int64(32)))[valid]
+    seam = np.flatnonzero(length == int(constants.SEAM_GRAM_LENGTH))
+    if len(seam):
+        from mapreduce_tpu.data import reader as reader_mod
+
+        length[seam] = reader_mod.scan_gram_lengths_bytes(
+            source, pos[seam].astype(np.int64), ngram)
     order = np.argsort(pos, kind="stable")
     words = [bytes(source[int(p): int(p) + int(l)]) for p, l in zip(pos[order], length[order])]
     dropped_uniques, dropped_count = tbl.dropped_totals()
@@ -350,12 +363,13 @@ def count_words(data: bytes, config: Config = DEFAULT_CONFIG) -> WordCountResult
 @functools.partial(jax.jit, static_argnames=("capacity", "n", "config"))
 def _ngram_step(data: jax.Array, capacity: int, n: int,
                 config: Config) -> table_ops.CountTable:
-    if config.resolved_backend() == "pallas":
-        from mapreduce_tpu.ops import ngram as ngram_ops
+    from mapreduce_tpu.ops import ngram as ngram_ops
 
+    if config.resolved_backend() == "pallas":
         return ngram_ops.ngram_table(data, n, capacity, 0, config)
-    stream = tok_ops.ngrams(tok_ops.tokenize(data), n)
-    return table_ops.from_stream(stream, capacity)
+    gs = ngram_ops.mark_long_spans(tok_ops.ngrams(tok_ops.tokenize(data), n))
+    return ngram_ops.gram_table(gs, capacity, 0, max_pos=data.shape[0],
+                                sort_mode=config.sort_mode)
 
 
 def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCountResult:
@@ -367,7 +381,7 @@ def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCo
     """
     padded = _pad_for_backend(data, config)
     tbl = _ngram_step(jax.device_put(padded), config.table_capacity, n, config)
-    return recover_result(tbl, data)
+    return recover_result(tbl, data, ngram=n)
 
 
 class BufferedTableState(NamedTuple):
@@ -619,13 +633,16 @@ class NGramCountJob(WordCountJob):
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array) -> table_ops.CountTable:
         """Per-chunk gram table (in-chunk windows only; the streamed seam
         machinery lives in :meth:`map_chunk_sharded` + :meth:`combine`)."""
-        if self.config.resolved_backend() == "pallas":
-            from mapreduce_tpu.ops import ngram as ngram_ops
+        from mapreduce_tpu.ops import ngram as ngram_ops
 
+        if self.config.resolved_backend() == "pallas":
             return ngram_ops.ngram_table(chunk, self.n, self.batch_capacity,
                                          chunk_id, self.config)
-        stream = tok_ops.ngrams(tok_ops.tokenize(chunk), self.n)
-        return table_ops.from_stream(stream, self.batch_capacity, pos_hi=chunk_id)
+        gs = ngram_ops.mark_long_spans(
+            tok_ops.ngrams(tok_ops.tokenize(chunk), self.n))
+        return ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
+                                    max_pos=chunk.shape[0],
+                                    sort_mode=self.config.sort_mode)
 
     # -- exact cross-chunk grams (streamed runs) ----------------------------
 
@@ -651,8 +668,10 @@ class NGramCountJob(WordCountJob):
                 chunk, self.n, self.batch_capacity, chunk_id, self.config)
         else:
             stream = tok_ops.tokenize(chunk)
-            gs = tok_ops.ngrams(stream, self.n)
-            t = table_ops.from_stream(gs, self.batch_capacity, pos_hi=chunk_id)
+            gs = ngram_ops.mark_long_spans(tok_ops.ngrams(stream, self.n))
+            t = ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
+                                     max_pos=chunk.shape[0],
+                                     sort_mode=self.config.sort_mode)
             summ = ngram_ops.summary_from_stream(stream, chunk_id, self.n)
         gathered = jax.lax.all_gather(summ, axis_name=axis)  # leaves [D, n-1]
         return NGramUpdate(batch=t, summaries=gathered,

@@ -124,18 +124,72 @@ def grams_from_sorted(key_hi: jax.Array, key_lo: jax.Array,
     )
 
 
+def mark_long_spans(stream: TokenStream) -> TokenStream:
+    """Length-plane policy for gram tables, identical in every backend:
+    spans < 127 bytes are stored exactly; longer spans (and exactly-127
+    ones) store ``SEAM_GRAM_LENGTH`` and the host recovers the span by
+    scanning ``n`` entries forward from the start (the cross-chunk seam
+    entry idiom, :func:`...data.reader.scan_gram_lengths`).  Inter-token
+    separator runs are unbounded, so no static bound on a gram span exists
+    — the 7-bit cap is what lets :func:`gram_table` ride the packed
+    sort-lean aggregation (``pos << 7 | len`` in one uint32) instead of the
+    generic 7-array build (ROADMAP r4 #4)."""
+    long = (stream.count > 0) & (stream.length >= jnp.uint32(127))
+    return stream._replace(length=jnp.where(
+        long, jnp.uint32(constants.SEAM_GRAM_LENGTH), stream.length))
+
+
+def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
+               max_pos: int, sort_mode: str = "stable2") -> table_ops.CountTable:
+    """Aggregate a position-ordered gram stream into a count table.
+
+    Both backends' gram streams arrive in ascending start-position order
+    (the pallas path pairs position-sorted rows; the XLA path's per-byte
+    stream is indexed by byte), which is exactly the stable2 packed-path
+    precondition — so when every position fits 25 bits (chunks <= 32 MB,
+    the production default) the build is the same 3-array 2-key stable
+    sort the wordcount family runs, instead of the generic 7-array 4-key
+    build (~2.3x the sorted bytes).  Lengths ride packed as
+    ``min(span, 127)``; 127 means "long span" and unpacks to the
+    ``SEAM_GRAM_LENGTH`` scan-forward sentinel (:func:`mark_long_spans`
+    must already have applied the same policy to ``gs`` so the generic
+    fallback's length plane is bit-identical).
+
+    ``max_pos`` is the static bound on gram start positions — the padded
+    chunk length (NOT the stream row count: the pallas kernel's compacted
+    stream has ~3x fewer rows than chunk bytes, but its positions still
+    span the whole chunk).
+    """
+    # pos << 7 needs pos < 2**25; the padded chunk length is a trace-time
+    # constant, so the gate is static.
+    if max_pos > (1 << 25):
+        return table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+    live = gs.count > 0
+    len7 = jnp.minimum(gs.length, jnp.uint32(127))
+    packed = jnp.where(live, (gs.pos << jnp.uint32(7)) | len7, _SENT_PACKED)
+    # sort_mode passes through unchanged: stable2's position-order
+    # precondition holds here (docstring), sort3/segmin have none, and
+    # from_packed_rows owns the segmin-on-TPU refusal.
+    t = table_ops.from_packed_rows(
+        gs.key_hi, gs.key_lo, packed, jnp.sum(gs.count), capacity, pos_hi,
+        len_bits=7, sort_mode=sort_mode)
+    occ = t.occupied()
+    return t._replace(length=jnp.where(
+        occ & (t.length == jnp.uint32(127)),
+        jnp.uint32(constants.SEAM_GRAM_LENGTH), t.length))
+
+
 def ngram_table(chunk: jax.Array, n: int, capacity: int,
                 pos_hi: jax.Array | int, config) -> table_ops.CountTable:
     """Per-chunk n-gram count table on the pallas backend.
 
     One straight-line program: fused kernel -> position sort (poison rows
-    included) -> elementwise pairing -> generic table build (gram spans
-    exceed the 6-bit packed length, so the packed table fast path does not
-    apply).  Grams containing a suppressed >W-byte token self-invalidate at
-    the poison rows (module docstring) and are accounted exactly: the
-    closed-form chunk gram total is ``max(all_tokens - (n-1), 0)`` with
-    ``all_tokens`` including overlong ones, so whatever the pairing did not
-    form was dropped by suppression.
+    included) -> elementwise pairing -> packed table build
+    (:func:`gram_table`).  Grams containing a suppressed >W-byte token
+    self-invalidate at the poison rows (module docstring) and are accounted
+    exactly: the closed-form chunk gram total is ``max(all_tokens - (n-1),
+    0)`` with ``all_tokens`` including overlong ones, so whatever the
+    pairing did not form was dropped by suppression.
     """
     t, _ = ngram_map_with_summary(chunk, n, capacity, pos_hi, config)
     return t
@@ -152,8 +206,9 @@ def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
         chunk, max_token_bytes=config.pallas_max_token)
     stream = pallas_tok.concat_streams(col, seam)
     key_hi, key_lo, packed = position_sorted(stream)
-    gs = grams_from_sorted(key_hi, key_lo, packed, n)
-    t = table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+    gs = mark_long_spans(grams_from_sorted(key_hi, key_lo, packed, n))
+    t = gram_table(gs, capacity, pos_hi, max_pos=chunk.shape[0],
+                   sort_mode=config.sort_mode)
     # Live sorted rows = real tokens + one poison row per overlong end.
     all_tokens = stream.total + overlong
     nm1 = jnp.uint32(n - 1)

@@ -508,3 +508,34 @@ def test_streamed_ngrams_2d_mesh_exact(tmp_path):
     assert result.total == single.total
     assert result.as_dict() == single.as_dict()
     assert result.words == single.words
+
+
+def test_long_span_grams_recovered_exactly(tmp_path):
+    """Gram spans >= 127 bytes (unbounded separator runs between tokens)
+    exceed the packed build's 7-bit length field: the table stores the
+    SEAM_GRAM_LENGTH scan-forward sentinel and recovery rescans the span —
+    single-buffer (scan_gram_lengths_bytes) and streamed
+    (scan_gram_lengths) alike, on both backends, bit-identically."""
+    from mapreduce_tpu.runtime.executor import count_file
+
+    corpus = (b"alpha" + b" " * 200 + b"beta gamma ") * 3 + b"alpha beta"
+    expect = ngram_oracle(corpus, 2)
+    xla_cfg = Config(table_capacity=1 << 14, backend="xla")
+    xla = wordcount.count_ngrams(corpus, 2, xla_cfg)
+    pal = wordcount.count_ngrams(corpus, 2, PALLAS_CFG)
+    assert xla.as_dict() == expect
+    assert pal.as_dict() == expect
+    assert pal.words == xla.words
+    # The long-gap bigram's reported span really is the 200-separator one.
+    assert any(len(w) > 200 for w in xla.words)
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(corpus)
+    streamed = count_file(str(path), config=Config(
+        chunk_bytes=1024, table_capacity=1 << 14, backend="xla"), ngram=2)
+    assert streamed.total == xla.total
+    # Token-keyed comparison (the streamed-comparison caveat,
+    # ngram_counts_by_tokens): a wrong host-rescanned span would split
+    # into the wrong token tuple and miss here.
+    by_tokens = {tuple(oracle.split_words(w)): c
+                 for w, c in zip(streamed.words, streamed.counts)}
+    assert by_tokens == ngram_counts_by_tokens(corpus, 2)
