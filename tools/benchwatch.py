@@ -116,6 +116,10 @@ def main() -> int:
                  {**ab, "BENCH_COMPACT_SLOTS": "0",
                   "BENCH_SORT_MODE": "sort3"}),
                 ("sortbench", [sys.executable, "tools/sortbench.py"], env),
+                # Round-5 packed gram build vs the generic 7-array build
+                # (ops/ngram.py gram_table; +21% on CPU, expect more where
+                # the sort is the floor).
+                ("grambench", [sys.executable, "tools/grambench.py"], env),
                 ("bench-natural-100mb", [sys.executable, "bench.py"],
                  {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "100"}),
                 ("bench-webby", [sys.executable, "bench.py"],
