@@ -462,12 +462,14 @@ def main() -> int:
 
         # Warm-up: pays the XLA compiles (one for the (k, repeats) program,
         # one for finish -- finish does not donate, so the state stays valid).
+        t_c0 = time.perf_counter()
         state = engine.step_many(state, staged, 0, repeats=repeats)
         # Generic ONE-ELEMENT host fetch: the state may be a bare CountTable
         # or (with BENCH_MERGE_EVERY > 1) a buffered pytree around one.  A
         # fetch, not jax.block_until_ready — that is not a real barrier
         # under remote-device tunnels (BENCHMARKS.md "Measurement rules").
         np.asarray(jax.tree.leaves(state)[0].ravel()[:1])
+        compile_s = time.perf_counter() - t_c0
         _log("warm-up dispatch done (compile paid)", wall0)
         np.asarray(engine.finish(state).dropped_count)
         _log("warm finish done", wall0)
@@ -505,7 +507,20 @@ def main() -> int:
             "words_per_s": round(words_per_s, 0),
         }
         _write_last_good(_PARTIAL_RESULT)
-        _rearm_watchdog(watchdog_s or 480, wall0)
+        # The streamed phase's own fresh compiles (step + step_many +
+        # finish at the streamed shapes) scale with relay-window quality
+        # like the headline compile just measured — but the headline is
+        # often a persistent-cache HIT while the streamed shapes compile
+        # fresh (observed: 50 s headline, ~615 s streamed compiles, same
+        # window), so the proportional term alone is not enough.  The
+        # device was provably alive seconds ago and a late watchdog still
+        # emits the partial headline, while an early one throws the
+        # streamed row away — the risk is asymmetric, so the floor is
+        # generous (observed worst case: streamed > 1500 s in a 565-s-
+        # compile window, BENCHMARKS.md round 5).
+        streamed_budget = max(watchdog_s or 480, int(3 * compile_s) + 300,
+                              1800)
+        _rearm_watchdog(streamed_budget, wall0)
 
         # End-to-end STREAMED ingest (VERDICT r3 #7): reader + prefetch +
         # H2D + compute + collective finish through the executor's run_job
@@ -533,7 +548,7 @@ def main() -> int:
                 executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
                                  mesh=mesh, byte_range=(0, warm_hi))
                 _log("streamed warm-up done (compile paid)", wall0)
-                _rearm_watchdog(watchdog_s or 480, wall0)
+                _rearm_watchdog(streamed_budget, wall0)
                 t0 = time.perf_counter()
                 rr = executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
                                       mesh=mesh)
