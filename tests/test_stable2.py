@@ -176,3 +176,17 @@ def _interpret():
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.force_tpu_interpret_mode()
+
+
+def test_gram_build_bit_identical_across_sort_modes(rng):
+    """The packed gram build (ops/ngram.py gram_table) honors sort_mode:
+    stable2 (tie-order first occurrence, the default) and sort3 (third
+    comparator key) must produce identical results — including a
+    >= 127-byte span riding the scan-forward length sentinel."""
+    corpus = make_corpus(rng, n_words=1500, vocab=80) \
+        + b" word" + b" " * 140 + b"pair tail"
+    with _interpret():
+        a = wordcount.count_ngrams(corpus, 2, _cfg("sort3"))
+        b = wordcount.count_ngrams(corpus, 2, _cfg("stable2"))
+    _assert_results_equal(a, b)
+    assert any(len(w) > 140 for w in a.words)
