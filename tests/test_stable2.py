@@ -130,14 +130,29 @@ def test_stable2_spill_falls_back_exactly():
 
 
 def test_stable2_streamed_executor(tmp_path, rng):
+    """Streamed sort3 (8-device mesh) == stable2 (4-device mesh).
+
+    Mesh sizes differ deliberately: the lane-major kernel under an
+    8-wide shard_map deadlocks JAX's pallas INTERPRET machinery on this
+    one-core box (faulthandler dump, round 5: interpret threads wedged
+    in _allocate_buffer/_barrier while run_job drains).  sort3's
+    slot-major kernel streams fine 8-wide, stable2 is demonstrably fine
+    4-wide (tests/test_pallas.py streams the stable2 default on a
+    4-device mesh), and the REAL Mosaic kernel streams 8+ wide on-chip
+    (the bench streamed phase runs exactly that).  Comparing across
+    mesh widths additionally asserts mesh-size invariance of results.
+    """
+    from mapreduce_tpu.parallel.mesh import data_mesh
     from mapreduce_tpu.runtime.executor import count_file
 
     corpus = make_corpus(rng, n_words=6000, vocab=150)
     p = tmp_path / "c.txt"
     p.write_bytes(corpus)
     with _interpret():
-        a = count_file([str(p)], config=_cfg("sort3", chunk_bytes=1 << 14))
-        b = count_file([str(p)], config=_cfg("stable2", chunk_bytes=1 << 14))
+        a = count_file([str(p)], config=_cfg("sort3", chunk_bytes=1 << 14),
+                       mesh=data_mesh(8))
+        b = count_file([str(p)], config=_cfg("stable2", chunk_bytes=1 << 14),
+                       mesh=data_mesh(4))
     _assert_results_equal(a, b)
     assert a.as_dict() == oracle.word_counts(corpus)
 
