@@ -110,16 +110,24 @@ def key_range_merge(table: table_ops.CountTable, axis,
     (key_hi, key_lo) keys, so key_hi ranges are mass-skewed toward small
     values, while the second hash word stays uniform under that selection.
 
-    Exactness: each destination block has a fixed budget B = ceil(s*C/D)
-    rows; a device whose partition overflows B spills its LARGEST keys
-    past the budget (rank order = key order).  Spilling key k implies >= B
-    smaller distinct keys in that partition, all of which reach the owner,
-    whose capacity-B reduce then evicts k everywhere it survived — so a
-    spilled key is never reported with a partial count: it is fully
-    evicted and accounted in ``dropped_*``, the same contract as capacity
-    spill (ops/table.py module docstring).  With hash-uniform keys,
-    P(partition load > 2C/D) is Chernoff-negligible, so in practice (and
-    in every no-spill run) the result is bit-identical to tree/gather.
+    Exactness: each destination block has a fixed budget
+    ``B = ceil(s*C/D) + 8 + 4*ceil(log2 D)`` rows; a device whose
+    partition overflows B spills its LARGEST keys past the budget (rank
+    order = key order).  Spilling key k implies >= B smaller distinct
+    keys in that partition, all of which reach the owner, whose
+    capacity-B reduce then evicts k everywhere it survived — so a spilled
+    key is never reported with a partial count: it is fully evicted and
+    accounted in ``dropped_*``, the same contract as capacity spill
+    (ops/table.py module docstring).  The budget needs BOTH terms: for
+    hash-uniform keys the max partition load is ~mean + O(sqrt(mean log
+    D) + log D) (balls in bins), so a purely multiplicative slack fails
+    exactly when C/D is small — at C=512, D=256 the mean is 2 rows but
+    the max is ~9, so ``b = 2*C/D = 4`` spilled real keys and the merge
+    (correctly, per the spill contract) diverged from tree on kept keys
+    (found by the D=256 scale dryrun, round 5).  The additive term is
+    noise at pod scale (+~40 rows on a 2048-row block at C=256K, D=256)
+    and makes the no-spill regime — where the result is bit-identical to
+    tree/gather — cover every realistic shape including tiny dryruns.
 
     Works for any axis size (not just powers of two) and for tuple axes
     (the mesh is flattened; the single a2a round trades the ICI/DCN
@@ -129,7 +137,7 @@ def key_range_merge(table: table_ops.CountTable, axis,
     cap = table.capacity
     if d == 1:
         return table
-    b = min(cap, -(-int(slack * cap) // d))
+    b = min(cap, -(-int(slack * cap) // d) + 8 + 4 * (d - 1).bit_length())
     sent = jnp.uint32(table_ops.constants.SENTINEL_KEY)
     inf = jnp.uint32(table_ops.constants.POS_INF)
     zero = jnp.uint32(0)
