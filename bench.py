@@ -270,6 +270,20 @@ def _write_last_good(result: dict) -> None:
         # A CPU smoke run must not clobber the TPU evidence a wedged later
         # round needs to fall back on.
         return
+    # A/B rows are evidence for BENCHMARKS.md, not the headline: letting
+    # them overwrite LAST_GOOD makes the record look like a regression (a
+    # markup run clobbered the 0.4275 zipf record this round; round 4 had
+    # to restore the headline the same way).  Closed as a CLASS: any
+    # BENCH_* knob that alters the measured run refuses the write — only
+    # the listed harness knobs (which leave the measurement itself
+    # unchanged) are headline-safe, so a future knob is refused by
+    # default instead of silently clobbering.
+    harness_only = {"BENCH_WATCHDOG_S", "BENCH_PROBE",
+                    "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE"}
+    if result.get("input") != "synthetic-zipf" or any(
+            k.startswith("BENCH_") and k not in harness_only
+            and os.environ.get(k) for k in os.environ):
+        return
     try:
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump({**result, "recorded_at": time.strftime(
