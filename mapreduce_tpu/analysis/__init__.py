@@ -1,0 +1,21 @@
+"""graphcheck: jaxpr-level static analysis for map/reduce programs.
+
+Certifies a :class:`~mapreduce_tpu.parallel.mapreduce.MapReduceJob` before
+it hits the TPU: hooks are traced to jaxprs under abstract inputs and a
+pluggable pass pipeline checks reducer algebra, accumulator dtypes vs
+corpus scale, host-sync/recompile hazards, and sharding/collective axis
+consistency.  See ``docs/analysis.md`` and the CLI
+(``python -m mapreduce_tpu.analysis`` / ``tools/graphcheck.py``).
+"""
+
+from mapreduce_tpu.analysis.core import (AnalysisContext, Finding, Report,
+                                         ERROR, WARNING, INFO,
+                                         analyze_job, default_pipeline,
+                                         pass_ids, register_pass,
+                                         run_pipeline)
+# Importing the package registers the built-in pipeline.
+from mapreduce_tpu.analysis import passes as _passes  # noqa: F401
+
+__all__ = ["AnalysisContext", "Finding", "Report", "ERROR", "WARNING",
+           "INFO", "analyze_job", "default_pipeline", "pass_ids",
+           "register_pass", "run_pipeline"]

@@ -1,0 +1,7 @@
+"""``python -m mapreduce_tpu.analysis`` -> the graphcheck CLI."""
+
+import sys
+
+from mapreduce_tpu.analysis.cli import main
+
+sys.exit(main())

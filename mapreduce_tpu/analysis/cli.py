@@ -1,0 +1,86 @@
+"""graphcheck CLI: run the pass pipeline over built-in models.
+
+``python -m mapreduce_tpu.analysis --all-models`` (or
+``python tools/graphcheck.py``) analyzes the shipped model zoo and exits
+non-zero when any error-severity finding fires — the CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graphcheck",
+        description="jaxpr-level static analyzer for mapreduce_tpu jobs "
+                    "(reducer algebra, overflow/dtype, host-sync, "
+                    "sharding lints).")
+    p.add_argument("models", nargs="*",
+                   help="built-in model names to analyze "
+                        "(default: all; see --list)")
+    p.add_argument("--all-models", action="store_true",
+                   help="analyze every built-in model")
+    p.add_argument("--list", action="store_true",
+                   help="list built-in models and registered passes")
+    p.add_argument("--corpus-bytes", type=int, default=1 << 40,
+                   help="corpus-scale bound for the overflow lint "
+                        "(default 1 TiB)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the structured report as JSON")
+    p.add_argument("--min-severity", choices=("error", "warning", "info"),
+                   default="info",
+                   help="hide findings below this severity in text output")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device count for the analysis mesh "
+                        "(forced-CPU; default 8)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Static analysis needs devices only to build a mesh; force the CPU
+    # platform with a virtual mesh so graphcheck runs anywhere (the
+    # tests/driver idiom — runtime/platform.py owns the mechanics).  A
+    # process that already initialized a backend keeps it.
+    from mapreduce_tpu.runtime.platform import force_cpu
+
+    jax = force_cpu(min_devices=args.devices)
+
+    from mapreduce_tpu import analysis
+    from mapreduce_tpu import models as models_mod
+    from mapreduce_tpu.parallel.mesh import data_mesh
+
+    if args.list:
+        print("models:", ", ".join(models_mod.model_names()))
+        print("passes:", ", ".join(analysis.pass_ids()))
+        return 0
+
+    names = list(args.models)
+    if args.all_models or not names:
+        names = models_mod.model_names()
+
+    mesh = data_mesh(min(args.devices, len(jax.devices())))
+    report = analysis.Report()
+    for name in names:
+        try:
+            job = models_mod.build_model(name)
+        except ValueError as e:
+            print(f"graphcheck: {e}", file=sys.stderr)
+            return 2
+        one = analysis.analyze_job(job, model=name, mesh=mesh,
+                                   corpus_bytes=args.corpus_bytes)
+        report.models.extend(one.models)
+        report.extend(one.findings)
+
+    if args.json:
+        print(report.as_json())
+    else:
+        print(report.format_text(min_severity=args.min_severity))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Built-in graphcheck passes.  Import order = pipeline run order."""
+
+from mapreduce_tpu.analysis.passes import (algebra, overflow, hostsync,
+                                           sharding)
+
+__all__ = ["algebra", "overflow", "hostsync", "sharding"]

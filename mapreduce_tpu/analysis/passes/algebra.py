@@ -1,0 +1,171 @@
+"""Pass: reducer-algebra checker.
+
+The collective global reduce (tree ``ppermute`` butterfly, ``all_gather``
+fold, key-range ``all_to_all``) is only correct when ``merge`` is
+associative AND commutative — the engine reorders and re-associates merge
+applications freely across devices.  Nothing in the type system enforces
+that, and the reference program silently assumed it (``reducer``,
+``main.cu:69-108``).
+
+Two complementary checks:
+
+* **structural**: walk the ``merge``/``combine`` jaxprs for primitives that
+  are intrinsically non-commutative/non-associative when they land on the
+  accumulator path — ``sub``/``div``/``rem``/``pow``, and ``scatter``
+  (overwrite semantics: last write wins, so merge order changes results;
+  ``scatter-add`` is the order-independent form).  Index arithmetic uses
+  these legitimately (sort ranks, prefix-sum differences), so structural
+  hits alone are advisory (INFO/WARNING);
+* **randomized property check**: the decider, and the fallback for opaque
+  subtrees the structural walk cannot see through.  Reachable states are
+  generated through the job's own map/combine machinery (random bit
+  patterns would violate state invariants and prove nothing) and
+  ``merge(a, b) == merge(b, a)`` / ``merge(merge(a, b), c) ==
+  merge(a, merge(b, c))`` are checked on them.  A mismatch is an ERROR:
+  the collective reduce WILL give device-count-dependent answers.
+
+Jobs whose states carry redundant coordination leaves that are only equal
+in real collective context (grep's ``line_carry``, the n-gram seam carry)
+declare an ``analysis_observables(state)`` hook returning the result-
+bearing sub-pytree the property check should compare.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from mapreduce_tpu.analysis import core, trace
+
+# Primitives that break commutativity/associativity when applied to the
+# accumulated values themselves.
+_NONCOMMUTATIVE = {"sub", "div", "rem", "pow", "atan2"}
+# Scatter variants: plain scatter = overwrite (last write wins).
+_SCATTER_OVERWRITE = {"scatter"}
+
+
+def _structural_findings(ctx: core.AnalysisContext, hook: str,
+                         jaxpr) -> list[core.Finding]:
+    out = []
+    seen: set[str] = set()
+    for eqn, _ in trace.iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in _NONCOMMUTATIVE and name not in seen:
+            seen.add(name)
+            out.append(core.Finding(
+                severity=core.INFO, pass_id=AlgebraPass.pass_id,
+                model=ctx.model, hook=hook,
+                message=(f"non-commutative primitive '{name}' reachable in "
+                         f"{hook} (advisory: legitimate for index math; the "
+                         "randomized property check decides)"),
+                location=trace.eqn_location(eqn),
+                hint="ensure the accumulator fold itself is order-independent"))
+        elif name in _SCATTER_OVERWRITE and name not in seen:
+            seen.add(name)
+            out.append(core.Finding(
+                severity=core.WARNING, pass_id=AlgebraPass.pass_id,
+                model=ctx.model, hook=hook,
+                message=("scatter-OVERWRITE reachable in "
+                         f"{hook}: last write wins, so merge order changes "
+                         "results on colliding keys"),
+                location=trace.eqn_location(eqn),
+                hint="use scatter-add (.at[idx].add) or scatter-max for "
+                     "order-independent accumulation"))
+    return out
+
+
+def _observables(job, state):
+    fn = getattr(job, "analysis_observables", None)
+    return fn(state) if fn is not None else state
+
+
+def _diff_leaves(job, x, y) -> list[str]:
+    """Paths of observable leaves where two states disagree."""
+    xs = trace.named_leaves(_observables(job, x))
+    ys = trace.named_leaves(_observables(job, y))
+    bad = []
+    for (px, lx), (_, ly) in zip(xs, ys):
+        ax, ay = np.asarray(lx), np.asarray(ly)
+        if np.issubdtype(ax.dtype, np.floating):
+            ok = np.allclose(ax, ay, rtol=1e-5, atol=1e-6, equal_nan=True)
+        else:
+            ok = np.array_equal(ax, ay)
+        if not ok:
+            bad.append(px)
+    return bad
+
+
+@core.register_pass
+class AlgebraPass:
+    pass_id = "reducer-algebra"
+    description = ("merge must be associative+commutative for the "
+                   "collective reduce (structural walk + randomized "
+                   "property check on reachable states)")
+
+    def run(self, ctx: core.AnalysisContext) -> list[core.Finding]:
+        out: list[core.Finding] = []
+        for hook in ("merge", "combine"):
+            traced = ctx.hook_traces.get(hook)
+            if isinstance(traced, trace.TraceFailure):
+                out.append(core.Finding(
+                    severity=core.INFO, pass_id=self.pass_id,
+                    model=ctx.model, hook=hook,
+                    message=(f"{hook} is opaque to structural analysis "
+                             f"({traced.error_type}: {traced.error}); "
+                             "relying on the property-check fallback"),
+                    hint="make the hook traceable under abstract inputs"))
+            elif traced is not None:
+                out.extend(_structural_findings(ctx, hook, traced))
+
+        states = ctx.property_states()
+        if len(states) < 3:
+            why = ctx.property_failure
+            detail = f" ({why.error_type}: {why.error})" if why else ""
+            out.append(core.Finding(
+                severity=core.WARNING, pass_id=self.pass_id,
+                model=ctx.model, hook="merge",
+                message="property check skipped: could not generate "
+                        f"reachable states on this host{detail}",
+                hint="run graphcheck where the job's backend can execute "
+                     "(the structural findings above are all it verified)"))
+            return out
+        a, b, c = states[:3]
+        job = ctx.job
+        try:
+            merge = jax.jit(job.merge)
+            ab, ba = merge(a, b), merge(b, a)
+            comm_bad = _diff_leaves(job, ab, ba)
+            ab_c = merge(merge(a, b), c)
+            a_bc = merge(a, merge(b, c))
+            assoc_bad = _diff_leaves(job, ab_c, a_bc)
+        except Exception as e:
+            out.append(core.Finding(
+                severity=core.WARNING, pass_id=self.pass_id,
+                model=ctx.model, hook="merge",
+                message=f"property check failed to run ({type(e).__name__}: "
+                        f"{e})",
+                hint="merge must accept two states of init_state's shape"))
+            return out
+        if comm_bad:
+            out.append(core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id,
+                model=ctx.model, hook="merge",
+                message=("merge is NOT commutative on reachable states: "
+                         f"merge(a,b) != merge(b,a) at {comm_bad[:4]}"),
+                location=", ".join(comm_bad[:4]),
+                hint="the collective tree/gather reduce reorders operands "
+                     "freely; rewrite merge as an order-independent fold "
+                     "(sum/min/max/union), or declare coordination-only "
+                     "leaves via analysis_observables"))
+        if assoc_bad:
+            out.append(core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id,
+                model=ctx.model, hook="merge",
+                message=("merge is NOT associative on reachable states: "
+                         f"merge(merge(a,b),c) != merge(a,merge(b,c)) at "
+                         f"{assoc_bad[:4]}"),
+                location=", ".join(assoc_bad[:4]),
+                hint="tree-merge re-associates across devices; make the "
+                     "fold associative or use the gather strategy with a "
+                     "documented fold order"))
+        return out

@@ -1,0 +1,190 @@
+"""Pass: overflow / dtype lint.
+
+JAX-on-TPU runs with x64 disabled, so every device integer is 32 bits —
+and a 32-bit count accumulator silently wraps at corpus scale (the exact
+failure mode the reference hits past ``MAX_OUTPUT_COUNT``,
+``main.cu:103-104``, and the one this framework exists to never have).
+The framework-wide convention is the uint32 ``lo``/``hi`` lane pair with
+explicit carry (``ops.table.add64``); this lint walks the accumulator
+state's dtypes against a configurable corpus-scale bound and flags
+counter-shaped leaves that are NOT lane-paired:
+
+* a leaf whose name says it counts (``count``/``total``/``matches``/
+  ``lines``/``sum``/``num``...) with an integer dtype of <= 32 bits and no
+  ``*_hi`` sibling lane is an ERROR when the corpus bound exceeds the
+  dtype's range, a WARNING when it is within one doubling;
+* integer downcasts (``convert_element_type`` to a narrower int) inside
+  ``combine``/``merge`` are WARNINGs — silent truncation on the
+  accumulator path;
+* the padding-sentinel envelope of the count-table plane is checked
+  statically: ``SENTINEL_KEY``/``POS_INF`` must be the maximum uint32 so
+  dead rows sort last (``ops/table.py`` invariant) — a changed constant
+  would silently corrupt every merge.
+
+The lane-pair convention recognized: ``X`` + ``X_hi``, or ``X_lo`` +
+``X_hi``, as NamedTuple siblings.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from mapreduce_tpu.analysis import core, trace
+
+_COUNTERISH = re.compile(
+    r"(count|total|matches|lines|occurrence|freq|sum|n_|num)", re.IGNORECASE)
+
+
+def _leaf_field(path: str) -> str:
+    """Final field name of a dotted leaf path."""
+    return path.rsplit(".", 1)[-1]
+
+
+def _sibling_fields(path: str, leaves: list[tuple[str, object]]) -> set[str]:
+    """Field names sharing the leaf's parent container."""
+    parent = path.rsplit(".", 1)[0] if "." in path else ""
+    out = set()
+    for p, _ in leaves:
+        if "." in p and p.rsplit(".", 1)[0] == parent:
+            out.add(_leaf_field(p))
+    return out
+
+
+def _lane_paired(field: str, siblings: set[str]) -> bool:
+    """True when the field participates in a lo/hi lane pair."""
+    if field.endswith("_hi"):
+        return True  # it IS a high lane
+    if field.endswith("_lo"):
+        return (field[:-3] + "_hi") in siblings
+    return (field + "_hi") in siblings
+
+
+def _int_capacity(dtype) -> int | None:
+    """Max representable count of an integer dtype (None for non-ints)."""
+    if not np.issubdtype(dtype, np.integer):
+        return None
+    info = np.iinfo(dtype)
+    return int(info.max)
+
+
+@core.register_pass
+class OverflowPass:
+    pass_id = "overflow-dtype"
+    description = ("accumulator dtypes vs corpus scale: un-paired 32-bit "
+                   "counters, integer downcasts, sentinel envelope")
+
+    def run(self, ctx: core.AnalysisContext) -> list[core.Finding]:
+        out: list[core.Finding] = []
+        out.extend(self._sentinel_findings(ctx))
+
+        st = ctx.state_shape
+        if isinstance(st, trace.TraceFailure):
+            out.append(core.Finding(
+                severity=core.WARNING, pass_id=self.pass_id,
+                model=ctx.model, hook="init_state",
+                message=f"state shape unavailable ({st.error_type}: "
+                        f"{st.error}); dtype lint skipped",
+                hint="make init_state traceable under jax.eval_shape"))
+            return out
+        leaves = trace.named_leaves(st)
+        bound = ctx.corpus_token_bound
+        # Jobs may exempt specific leaves (by field name or full path) that
+        # a name-based lint would misread — e.g. staging buffers of
+        # per-chunk counts whose values are bounded by chunk size, not
+        # corpus size.  The declaration site carries the justification.
+        exempt = set(getattr(ctx.job, "analysis_overflow_exempt", ()))
+        for path, leaf in leaves:
+            cap = _int_capacity(leaf.dtype)
+            if cap is None or cap >= (1 << 63) - 1:
+                continue
+            field = _leaf_field(path)
+            if path in exempt or field in exempt:
+                continue
+            if not _COUNTERISH.search(field):
+                continue
+            if _lane_paired(field, _sibling_fields(path, leaves)):
+                continue
+            if bound > cap:
+                out.append(core.Finding(
+                    severity=core.ERROR, pass_id=self.pass_id,
+                    model=ctx.model, hook="init_state",
+                    message=(f"counter leaf '{path}' is {leaf.dtype} "
+                             f"(max {cap:,}) but the corpus bound is "
+                             f"{bound:,} tokens: silent wrap at scale"),
+                    location=path,
+                    hint="carry the count as a uint32 lo/hi lane pair with "
+                         "explicit carry (ops.table.add64 — the grep "
+                         "accumulator idiom); device uint64 is unavailable "
+                         "with x64 off"))
+            elif bound > cap // 2:
+                out.append(core.Finding(
+                    severity=core.WARNING, pass_id=self.pass_id,
+                    model=ctx.model, hook="init_state",
+                    message=(f"counter leaf '{path}' is {leaf.dtype} "
+                             f"(max {cap:,}); the corpus bound {bound:,} is "
+                             "within one doubling of overflow"),
+                    location=path,
+                    hint="promote to a lo/hi lane pair before the next "
+                         "corpus scale-up"))
+
+        out.extend(self._downcast_findings(ctx))
+        return out
+
+    def _downcast_findings(self, ctx) -> list[core.Finding]:
+        out = []
+        for hook in ("combine", "merge"):
+            traced = ctx.hook_traces.get(hook)
+            if traced is None or isinstance(traced, trace.TraceFailure):
+                continue
+            seen = set()
+            for eqn, _ in trace.iter_eqns(traced):
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                new = np.dtype(eqn.params.get("new_dtype"))
+                old = eqn.invars[0].aval.dtype if eqn.invars else None
+                if old is None:
+                    continue
+                old = np.dtype(old)
+                if (np.issubdtype(old, np.integer)
+                        and np.issubdtype(new, np.integer)
+                        and new.itemsize < old.itemsize
+                        and (old, new) not in seen):
+                    seen.add((old, new))
+                    out.append(core.Finding(
+                        severity=core.WARNING, pass_id=self.pass_id,
+                        model=ctx.model, hook=hook,
+                        message=(f"integer downcast {old}->{new} on the "
+                                 f"{hook} path: high bits are silently "
+                                 "dropped"),
+                        location=trace.eqn_location(eqn),
+                        hint="keep accumulator arithmetic at full width "
+                             "(weak-type promotion can introduce this "
+                             "invisibly — pin dtypes with jnp.uint32(...))"))
+        return out
+
+    def _sentinel_findings(self, ctx) -> list[core.Finding]:
+        from mapreduce_tpu import constants
+
+        out = []
+        maxu = (1 << 32) - 1
+        if int(constants.SENTINEL_KEY) != maxu:
+            out.append(core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id,
+                model=ctx.model, hook="constants",
+                message=(f"SENTINEL_KEY is {int(constants.SENTINEL_KEY):#x}, "
+                         "not the maximum uint32: dead table rows would stop "
+                         "sorting last and every merge would corrupt"),
+                location="mapreduce_tpu/constants.py",
+                hint="keep SENTINEL_KEY = 0xFFFFFFFF"))
+        if int(constants.POS_INF) != maxu:
+            out.append(core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id,
+                model=ctx.model, hook="constants",
+                message=(f"POS_INF is {int(constants.POS_INF):#x}, not the "
+                         "maximum uint32: empty-slot positions would win "
+                         "first-occurrence minima"),
+                location="mapreduce_tpu/constants.py",
+                hint="keep POS_INF = 0xFFFFFFFF"))
+        return out

@@ -1,0 +1,119 @@
+"""Pass: sharding / collective-axis lint.
+
+The engine's SPMD programs bind mesh axes through ``shard_map``; a job's
+axis-aware map (or a hand-written collective) that names an axis the mesh
+does not carry fails — at best loudly at trace time, at worst (axis name
+collides with a DIFFERENT axis on a multi-axis mesh) by silently reducing
+over the wrong device group.  This pass checks, statically:
+
+* the engine ``step``/``finish`` programs trace at all — an unbound axis
+  name (the mismatched-PartitionSpec case) surfaces here and is converted
+  into the structured ERROR finding it is;
+* every ``shard_map`` binding inside the programs names only axes of the
+  analysis mesh;
+* every collective (``psum``/``all_gather``/``ppermute``/``all_to_all``/
+  ``axis_index``/``reduce_scatter``) reduces over axes bound by its
+  enclosing ``shard_map`` scope AND present on the mesh
+  (:mod:`mapreduce_tpu.parallel.collectives` contract: collectives must
+  be called inside ``shard_map``);
+* collectives over an axis the engine did NOT declare as a data axis on a
+  multi-axis mesh are WARNINGs (reducing over a strict subset of the
+  sharded axes is almost always a partial-merge bug).
+"""
+
+from __future__ import annotations
+
+from mapreduce_tpu.analysis import core, trace
+
+_COLLECTIVES = {"psum", "pmax", "pmin", "all_gather", "ppermute",
+                "all_to_all", "axis_index", "reduce_scatter",
+                "psum_scatter"}
+
+
+def _shard_map_axes(eqn) -> set[str]:
+    """Axis names a shard_map equation binds (from its in/out names and
+    its mesh param)."""
+    names: set[str] = set()
+    mesh = eqn.params.get("mesh")
+    names.update(getattr(mesh, "axis_names", ()) or ())
+    for key in ("in_names", "out_names"):
+        for entry in eqn.params.get(key, ()) or ():
+            if isinstance(entry, dict):
+                for v in entry.values():
+                    names.update(v if isinstance(v, (tuple, list)) else (v,))
+    return {n for n in names if isinstance(n, str)}
+
+
+@core.register_pass
+class ShardingPass:
+    pass_id = "sharding-lint"
+    description = ("shard_map/PartitionSpec axis names vs the mesh; "
+                   "collectives reduce over declared, bound axes")
+
+    def run(self, ctx: core.AnalysisContext) -> list[core.Finding]:
+        out: list[core.Finding] = []
+        mesh_axes = set(ctx.mesh.axis_names)
+        for hook, traced in ctx.engine_traces.items():
+            if isinstance(traced, trace.TraceFailure):
+                out.append(core.Finding(
+                    severity=core.ERROR, pass_id=self.pass_id,
+                    model=ctx.model, hook=hook,
+                    message=(f"engine {hook} program failed to trace "
+                             f"({traced.error_type}: {traced.error}) — "
+                             "typically a collective or PartitionSpec "
+                             "naming an axis the mesh does not carry"),
+                    hint=f"mesh axes are {sorted(mesh_axes)}; use the axis "
+                         "name the engine passes to map_chunk_sharded "
+                         "instead of hardcoding one"))
+                continue
+            out.extend(self._jaxpr_findings(ctx, hook, traced, mesh_axes))
+        return out
+
+    def _jaxpr_findings(self, ctx, hook, traced, mesh_axes):
+        out = []
+        seen: set[tuple] = set()
+        for eqn, bound in trace.iter_eqns(traced):
+            name = eqn.primitive.name
+            if name == "shard_map":
+                unknown = _shard_map_axes(eqn) - mesh_axes
+                if unknown and ("sm", tuple(sorted(unknown))) not in seen:
+                    seen.add(("sm", tuple(sorted(unknown))))
+                    out.append(core.Finding(
+                        severity=core.ERROR, pass_id=self.pass_id,
+                        model=ctx.model, hook=hook,
+                        message=(f"shard_map binds axis(es) "
+                                 f"{sorted(unknown)} absent from the mesh "
+                                 f"{sorted(mesh_axes)}"),
+                        location=trace.eqn_location(eqn),
+                        hint="build the mesh with matching axis names "
+                             "(parallel/mesh.py) or fix the PartitionSpec"))
+                continue
+            if name not in _COLLECTIVES:
+                continue
+            axes = trace.eqn_axis_names(eqn)
+            for ax in axes:
+                key = (name, ax)
+                if key in seen:
+                    continue
+                if ax not in mesh_axes:
+                    seen.add(key)
+                    out.append(core.Finding(
+                        severity=core.ERROR, pass_id=self.pass_id,
+                        model=ctx.model, hook=hook,
+                        message=(f"collective '{name}' reduces over axis "
+                                 f"{ax!r}, absent from the mesh "
+                                 f"{sorted(mesh_axes)}"),
+                        location=trace.eqn_location(eqn),
+                        hint="use the axis name the engine passes into "
+                             "map_chunk_sharded"))
+                elif ax not in bound:
+                    seen.add(key)
+                    out.append(core.Finding(
+                        severity=core.ERROR, pass_id=self.pass_id,
+                        model=ctx.model, hook=hook,
+                        message=(f"collective '{name}' over axis {ax!r} "
+                                 "outside any shard_map binding it"),
+                        location=trace.eqn_location(eqn),
+                        hint="collectives must run inside shard_map "
+                             "(parallel/collectives.py contract)"))
+        return out

@@ -1,0 +1,81 @@
+"""Built-in model registry.
+
+One place that names every shipped model family and builds a
+representative job for it — the surface the graphcheck CLI (and any future
+model-zoo tooling) enumerates.  Factories take a
+:class:`~mapreduce_tpu.config.Config` and return a fully-constructed job;
+models whose jobs are config-free by construction (grep: the pattern IS
+the job, there is no sizing to configure) accept and ignore it, so the
+registry surface stays uniform.  The default analysis config keeps shapes
+small (tracing and the randomized property checks run on the host in
+seconds, not minutes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from mapreduce_tpu.config import Config
+
+# Small shapes for static analysis / smoke tracing: the jaxprs are the
+# same graphs as production, just with smaller static dimensions.
+ANALYSIS_CONFIG = Config(chunk_bytes=1 << 10, table_capacity=512,
+                         backend="xla")
+
+
+def _wordcount(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    return WordCountJob(config)
+
+
+def _grep(config: Config):
+    from mapreduce_tpu.models.grep import GrepJob
+
+    del config  # GrepJob is config-free: the pattern is the whole job
+    return GrepJob(b"the")
+
+
+def _sample(config: Config):
+    from mapreduce_tpu.models.sample import ReservoirSampleJob
+
+    return ReservoirSampleJob(16, config)
+
+
+def _ngram(config: Config):
+    from mapreduce_tpu.models.wordcount import NGramCountJob
+
+    return NGramCountJob(2, config)
+
+
+def _sketch(config: Config):
+    from mapreduce_tpu.models.wordcount import (SketchedWordCountJob,
+                                                WordCountJob)
+
+    return SketchedWordCountJob(WordCountJob(config))
+
+
+_REGISTRY: Dict[str, Callable[[Config], object]] = {
+    "wordcount": _wordcount,
+    "grep": _grep,
+    "sample": _sample,
+    "ngram": _ngram,
+    "sketch": _sketch,
+}
+
+
+def model_names() -> list[str]:
+    return list(_REGISTRY)
+
+
+def build_model(name: str, config: Config = ANALYSIS_CONFIG):
+    """Construct the named built-in model's job."""
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; "
+                         f"known: {', '.join(_REGISTRY)}") from None
+    return factory(config)
+
+
+__all__ = ["ANALYSIS_CONFIG", "build_model", "model_names"]

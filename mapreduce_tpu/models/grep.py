@@ -402,6 +402,17 @@ class GrepJob(MapReduceJob):
         path's semantics identical)."""
         return state._replace(line_carry=jnp.zeros_like(state.line_carry))
 
+    def analysis_observables(self, state: GrepState):
+        """graphcheck metadata: the result-bearing leaves the randomized
+        merge property check compares.  ``line_carry`` is a coordination
+        bit — identical on every device within one run (computed from the
+        gathered summaries), so ``merge`` keeping either operand's is
+        correct — but states built from DIFFERENT chunks disagree on it,
+        which a bitwise commutativity check would misread as a reducer
+        bug."""
+        return (state.matches_lo, state.matches_hi,
+                state.lines_lo, state.lines_hi)
+
     def merge(self, a: GrepState, b: GrepState) -> GrepState:
         """Merge two accumulated states (collective finish, or cross-host).
 

@@ -367,7 +367,10 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
     # ~128 MB physical VMEM, so the override is safe headroom either way.
     # The pair path stays well under the default; one shared limit keeps
     # the call site single-owner.
-    params = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024) \
+    # Older jax spells the params class TPUCompilerParams; same fields.
+    _params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    params = _params_cls(vmem_limit_bytes=64 * 1024 * 1024) \
         if compact_slots else None
     outs = pl.pallas_call(
         kern,

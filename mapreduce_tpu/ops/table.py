@@ -96,21 +96,36 @@ class CountTable(NamedTuple):
         return (int(self.dropped_uniques) + (int(self.dropped_uniques_hi) << 32),
                 int(self.dropped_count) + (int(self.dropped_count_hi) << 32))
 
-    def total_count(self) -> jax.Array | int:
-        """Total tokens represented, including spilled ones.
+    def total_count64(self) -> tuple[jax.Array, jax.Array]:
+        """Exact 64-bit total as ``(lo, hi)`` uint32 lanes — safe under jit.
 
-        On concrete tables (host numpy leaves, or fetched device arrays)
-        the result is an exact int64 reconstruction of the 64-bit lanes.
-        Under jit tracing the low words alone are summed (no uint64 on
-        device); traced callers needing exact totals past 2**32 should
-        consume the lane pairs directly.
+        Per-key lanes are summed with wrap carry (:func:`sum64`) and the
+        ``dropped_*`` lanes folded in (:func:`add64`), so the pair is exact
+        at any corpus scale.  Host callers reconstructing an int:
+        ``(hi << 32) | lo`` (what :meth:`total_count` does for them).
+        """
+        lo, hi = sum64(self.count, self.count_hi)
+        return add64(lo, hi, self.dropped_count, self.dropped_count_hi)
+
+    def total_count(self) -> int:
+        """Total tokens represented, including spilled ones (exact int).
+
+        Host-side only: concrete tables (numpy leaves, or fetched device
+        arrays) reconstruct the 64-bit lanes in int64.  Under jit there is
+        no device uint64 (x64 off), so a single traced scalar cannot carry
+        the exact total — the old behavior summed the low words alone and
+        silently wrapped at 2**32, the 32-bit count-path hazard the
+        graphcheck overflow lint exists to catch.  Traced callers take the
+        exact lane pair from :meth:`total_count64` instead.
         """
         if not isinstance(self.count, jax.core.Tracer):
             lo = np.asarray(self.count).astype(np.int64)
             hi = np.asarray(self.count_hi).astype(np.int64)
             return int((lo + (hi << np.int64(32))).sum()) \
                 + int(self.dropped_count) + (int(self.dropped_count_hi) << 32)
-        return jnp.sum(self.count) + self.dropped_count
+        raise TypeError(
+            "CountTable.total_count() is host-side (returns an exact int); "
+            "under jit use total_count64() -> (lo, hi) uint32 lanes")
 
 
 def empty(capacity: int) -> CountTable:

@@ -28,12 +28,13 @@ called inside ``shard_map``.
 
 from __future__ import annotations
 
-from typing import Any, Callable, TypeVar
+from typing import Callable, TypeVar
 
 import jax
 import jax.numpy as jnp
 
 from mapreduce_tpu.ops import table as table_ops
+from mapreduce_tpu.parallel.compat import axis_size as _axis_size
 
 T = TypeVar("T")
 MergeFn = Callable[[T, T], T]
@@ -43,7 +44,7 @@ def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
     """Butterfly all-reduce: after log2(D) ppermute+merge rounds every device
     holds the merge of all D states.  Deterministic and replicated.
     """
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     if n & (n - 1):
         return gather_merge(state, merge, axis)
     rounds = n.bit_length() - 1
@@ -57,7 +58,7 @@ def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
 
 def gather_merge(state: T, merge: MergeFn, axis: str) -> T:
     """all_gather every state then fold left.  Any axis size; replicated."""
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     gathered = jax.tree.map(lambda x: jax.lax.all_gather(x, axis), state)
     take = lambda i: jax.tree.map(lambda x: x[i], gathered)
     acc = take(0)
@@ -133,7 +134,7 @@ def key_range_merge(table: table_ops.CountTable, axis,
     (the mesh is flattened; the single a2a round trades the ICI/DCN
     hierarchy for one scheduled collective).
     """
-    d = jax.lax.axis_size(axis)
+    d = _axis_size(axis)
     cap = table.capacity
     if d == 1:
         return table

@@ -1,0 +1,41 @@
+"""JAX API compatibility shims for the parallel layer.
+
+The framework targets the modern ``jax.shard_map`` (jax >= 0.6, where it
+moved out of ``jax.experimental`` and renamed ``check_rep`` to
+``check_vma``), but the baked toolchain may carry an older jax where only
+``jax.experimental.shard_map.shard_map`` exists.  One adapter owns the
+difference so every call site (engine builds, tests) uses the modern
+keyword surface unconditionally.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma keyword
+    from jax import shard_map as _shard_map
+
+    _VMA_KW = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _VMA_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword surface on any jax."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_VMA_KW: check_vma})
+
+
+def axis_size(axis) -> int:
+    """Static size of a bound mesh axis (or axis tuple), on any jax.
+
+    ``jax.lax.axis_size`` only exists on newer jax; ``psum(1, axis)`` is
+    the classic spelling and constant-folds to a Python int on every
+    version (callers rely on the result being static: merge-round counts
+    and power-of-two checks happen at trace time).
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)

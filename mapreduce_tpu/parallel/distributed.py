@@ -67,7 +67,7 @@ def initialize(coordinator_address: Optional[str] = None,
     # NOTE: must not touch jax.process_count()/jax.devices() here — any such
     # call initializes the XLA backend, after which
     # jax.distributed.initialize() refuses to run.
-    if jax.distributed.is_initialized():
+    if _is_initialized():
         return
     explicit = coordinator_address or num_processes or process_id
     env = (os.environ.get("COORDINATOR_ADDRESS")
@@ -90,6 +90,17 @@ def initialize(coordinator_address: Optional[str] = None,
               process=jax.process_index(), processes=jax.process_count(),
               local_devices=len(jax.local_devices()),
               global_devices=len(jax.devices()))
+
+
+def _is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` on any jax: the public predicate
+    only exists on newer versions; older ones expose the same fact as the
+    distributed client singleton (set exactly while initialized)."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "client", None) is not None
 
 
 def _on_cloud_tpu() -> bool:

@@ -79,3 +79,20 @@ def make_corpus(rng, n_words: int, vocab: int, zipf_a: float = 1.3, seed_words=N
 @pytest.fixture(scope="session")
 def small_corpus(rng) -> bytes:
     return make_corpus(rng, n_words=2000, vocab=150)
+
+
+def pallas_interpret_mode():
+    """Force pallas interpret mode, on any jax (single owner of the shim).
+
+    Newer jax has a global switch; older jax has none, but the kernel
+    wrapper already auto-interprets off-TPU (ops/pallas/tokenize.py
+    resolves interpret=None to "not on tpu"), so a no-op context preserves
+    semantics for CPU runs.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    if hasattr(pltpu, "force_tpu_interpret_mode"):
+        return pltpu.force_tpu_interpret_mode()
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -38,8 +38,6 @@ def main() -> int:
     dist.initialize(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=n_proc, process_id=pid, timeout_s=60)
 
-    import numpy as np
-
     from mapreduce_tpu.config import Config
     from mapreduce_tpu.models.wordcount import WordCountJob
     from mapreduce_tpu.runtime import executor

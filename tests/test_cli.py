@@ -1,6 +1,7 @@
 """CLI parity tests: stdout contract of main.cu:166-218 (SURVEY §7 'Exact CLI parity')."""
 
 import json
+import pytest
 import subprocess
 import sys
 from pathlib import Path
@@ -92,6 +93,7 @@ def test_top_k(tmp_path):
     assert r.stdout == "a\t3\nb\t2\n"
 
 
+@pytest.mark.slow
 def test_max_token_bytes_flag_on_pallas_backend(tmp_path):
     """--max-token-bytes reaches the pallas config: a token longer than W is
     rescued exactly by default (ops/rescue.py), and dropped into the
@@ -136,6 +138,7 @@ def test_distinct_sketch_requires_stream(tmp_path):
     assert "--distinct-sketch requires --stream" in r.stderr
 
 
+@pytest.mark.slow
 def test_multi_file_grep_no_cross_file_seam_match(tmp_path):
     """A newline-bearing pattern must not match across the artificial seam
     between joined input files (only NUL is rejected in patterns)."""
@@ -243,6 +246,7 @@ def test_sample_zero_is_an_error(tmp_path):
     assert len(json.loads(r2.stdout)["sample"]) == 2
 
 
+@pytest.mark.slow
 def test_merge_every_flag_validation(tmp_path):
     """--merge-every must error where it would be a silent no-op: without
     --stream, with --grep/--sample, and with --ngram (pairwise combine)."""

@@ -48,6 +48,7 @@ def mesh8():
 
 
 @pytest.mark.parametrize("strategy", ["tree", "gather"])
+@pytest.mark.slow
 def test_engine_wordcount_matches_oracle(mesh8, rng, strategy):
     corpus = make_corpus(rng, n_words=5000, vocab=300)
     eng = Engine(WordCountJob(CFG), mesh8, merge_strategy=strategy)
@@ -60,6 +61,7 @@ def test_engine_wordcount_matches_oracle(mesh8, rng, strategy):
     assert int(result.total_count()) == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_mesh_sizes_agree(rng):
     """Same corpus, meshes of 1/2/4/8 devices: identical count multisets."""
     corpus = make_corpus(rng, n_words=2000, vocab=120)
@@ -71,6 +73,7 @@ def test_mesh_sizes_agree(rng):
     assert results[1] == results[2] == results[4] == results[8]
 
 
+@pytest.mark.slow
 def test_gather_merge_non_power_of_two(rng):
     corpus = make_corpus(rng, n_words=1000, vocab=80)
     eng = Engine(WordCountJob(CFG), data_mesh(3), merge_strategy="tree")  # falls back
@@ -80,6 +83,7 @@ def test_gather_merge_non_power_of_two(rng):
         sorted(oracle.word_counts(corpus).values())
 
 
+@pytest.mark.slow
 def test_top_k_job(mesh8, rng):
     corpus = make_corpus(rng, n_words=3000, vocab=200)
     eng = Engine(TopKWordCountJob(10, CFG), mesh8)
@@ -97,8 +101,9 @@ def test_top_k_job(mesh8, rng):
 
 def test_psum_collective(mesh8):
     """Scalar totals ride the native psum path (the north-star collective)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mapreduce_tpu.parallel.compat import shard_map
 
     def f(x):
         return collectives.psum(x.sum(), "data")
@@ -108,6 +113,7 @@ def test_psum_collective(mesh8):
     assert int(out) == 64 * 63 // 2
 
 
+@pytest.mark.slow
 def test_step_many_equals_repeated_steps(mesh8, rng):
     """One superstep dispatch (lax.scan over K chunks) must produce exactly
     the same state as K individual steps, chunk_ids included."""
@@ -132,6 +138,7 @@ def test_step_many_equals_repeated_steps(mesh8, rng):
         np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
 
 
+@pytest.mark.slow
 def test_step_many_mixed_with_single_steps(mesh8, rng):
     """step_many must compose with step() (remainder batches) seamlessly."""
     corpus = make_corpus(rng, n_words=6000, vocab=250)
@@ -151,6 +158,7 @@ def test_step_many_mixed_with_single_steps(mesh8, rng):
     assert int(result.total_count()) == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_two_level_mesh_engine_matches_oracle(rng):
     """2-D ('replica','data') mesh with hierarchical (ICI-then-DCN) merge:
     the multi-slice topology of SURVEY §7 step 4, emulated as 2x4 CPU."""
@@ -168,6 +176,7 @@ def test_two_level_mesh_engine_matches_oracle(rng):
     assert int(result.total_count()) == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_two_level_matches_flat_mesh(rng):
     """Same devices, 1-D vs 2-D mesh: identical tables (chunk ids and all)."""
     from mapreduce_tpu.parallel.mesh import two_level_mesh
@@ -182,6 +191,7 @@ def test_two_level_matches_flat_mesh(rng):
         np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
 
 
+@pytest.mark.slow
 def test_count_file_over_two_level_mesh(tmp_path, rng):
     """The streaming executor must shard over ALL axes of a 2-D mesh (8
     shards from 2x4), not just the leading one."""
@@ -195,6 +205,7 @@ def test_count_file_over_two_level_mesh(tmp_path, rng):
     assert {w: c for w, c in zip(r.words, r.counts)} == oracle.word_counts(corpus)
 
 
+@pytest.mark.slow
 def test_step_many_repeats_equals_repeated_dispatch():
     """step_many(repeats=R) == R sequential step_many calls over the same
     chunks with advancing step indices (epoch semantics)."""
@@ -227,6 +238,7 @@ def test_step_many_repeats_equals_repeated_dispatch():
 # --- key-range all_to_all merge (VERDICT r3 #3) ------------------------------
 
 
+@pytest.mark.slow
 def test_keyrange_engine_matches_oracle(mesh8, rng):
     corpus = make_corpus(rng, n_words=5000, vocab=300)
     eng = Engine(WordCountJob(CFG), mesh8, merge_strategy="keyrange")
@@ -237,6 +249,7 @@ def test_keyrange_engine_matches_oracle(mesh8, rng):
     assert int(result.total_count()) == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_keyrange_bit_identical_to_tree(mesh8, rng):
     """No-spill runs: keyrange and tree produce the same table, field for
     field (kept keys, counts, first occurrences, dropped scalars)."""
@@ -248,6 +261,7 @@ def test_keyrange_bit_identical_to_tree(mesh8, rng):
         np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
 
 
+@pytest.mark.slow
 def test_keyrange_non_power_of_two(rng):
     """all_to_all has no power-of-two constraint (unlike the butterfly)."""
     corpus = make_corpus(rng, n_words=1500, vocab=90)
@@ -258,6 +272,7 @@ def test_keyrange_non_power_of_two(rng):
         sorted(oracle.word_counts(corpus).values())
 
 
+@pytest.mark.slow
 def test_keyrange_two_level_mesh(rng):
     """Tuple axes: the keyrange round flattens the 2-D mesh."""
     from mapreduce_tpu.parallel.mesh import two_level_mesh
@@ -306,8 +321,9 @@ def _crafted_tables(n_dev: int, cap: int, keys_per_dev, rng):
 
 
 def _run_collective(mesh, fn, stacked):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mapreduce_tpu.parallel.compat import shard_map
 
     def body(state):
         local = jax.tree.map(lambda x: x[0], state)
@@ -318,6 +334,7 @@ def _run_collective(mesh, fn, stacked):
     return jax.tree.map(np.asarray, jax.jit(wrapped)(stacked))
 
 
+@pytest.mark.slow
 def test_keyrange_budget_spill_never_partial(mesh8, rng):
     """Force one partition past the B = slack*C/D budget on one device: the
     spilled keys must be fully evicted everywhere (never reported with a
@@ -361,6 +378,7 @@ def test_keyrange_budget_spill_never_partial(mesh8, rng):
         assert max(surviving_hot, default=(0, 0)) < min(spilled)
 
 
+@pytest.mark.slow
 def test_keyrange_count_file_end_to_end(tmp_path, rng):
     """merge_strategy plumbs through run_job/count_file."""
     from mapreduce_tpu.runtime import executor
@@ -373,6 +391,7 @@ def test_keyrange_count_file_end_to_end(tmp_path, rng):
     assert {w: c for w, c in zip(r.words, r.counts)} == oracle.word_counts(corpus)
 
 
+@pytest.mark.slow
 def test_keyrange_tiny_capacity_skewed_partitions(mesh8, rng):
     """The small-C/D budget regime (round-5 D=256 scale-dryrun bug): with
     capacity/D of order 1, balls-in-bins max partition load exceeds any

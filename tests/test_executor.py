@@ -36,6 +36,7 @@ def test_count_file_insertion_order(tmp_path):
     assert result.counts == [3, 2, 1]
 
 
+@pytest.mark.slow
 def test_count_file_top_k(tmp_path, rng):
     corpus = make_corpus(rng, 3000, 150)
     path = _write(tmp_path, corpus)
@@ -54,6 +55,7 @@ def test_run_metrics(tmp_path, rng):
     assert "stream" in rr.metrics.phases and "reduce" in rr.metrics.phases
 
 
+@pytest.mark.slow
 def test_run_metrics_unwrap_topk_and_sketch(tmp_path, rng):
     """words_counted must survive every finalize result shape: the TopKTable
     wrapper (and its nesting inside sketch states) carries the table one
@@ -71,6 +73,7 @@ def test_run_metrics_unwrap_topk_and_sketch(tmp_path, rng):
     assert rr.metrics.words_counted == total
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_same_result(tmp_path, rng):
     """Kill-and-resume produces the identical count multiset (SURVEY §5)."""
     corpus = make_corpus(rng, 5000, 200)
@@ -125,6 +128,7 @@ def test_checkpoint_capacity_mismatch_rejected(tmp_path, rng):
                             mesh=data_mesh(2), checkpoint_path=ck, checkpoint_every=1)
 
 
+@pytest.mark.slow
 def test_stream_and_single_buffer_top_k_agree(tmp_path):
     """Device-side and host-side top-k must break count ties identically
     (by first occurrence), so --stream --top-k and --top-k match."""
@@ -140,6 +144,7 @@ def test_stream_and_single_buffer_top_k_agree(tmp_path):
     assert streamed.counts == single.counts
 
 
+@pytest.mark.slow
 def test_stream_top_k_total_is_exact(tmp_path, rng):
     """--stream --top-k must report the full token total, not the top-k sum."""
     corpus = make_corpus(rng, 2000, 120)
@@ -166,6 +171,7 @@ def test_checkpoint_roundtrip(tmp_path):
                                       np.asarray(getattr(s2, f)))
 
 
+@pytest.mark.slow
 def test_stream_superstep_matches_single_step(tmp_path, rng):
     """config.superstep>1 (scan-fused dispatches + remainder single steps)
     must produce the identical result and checkpoint-compatible bases."""
@@ -181,6 +187,7 @@ def test_stream_superstep_matches_single_step(tmp_path, rng):
     assert r1.words == r3.words and r1.total == r3.total
 
 
+@pytest.mark.slow
 def test_sketched_checkpoint_resume(tmp_path, rng):
     """Sketched runs checkpoint (table + HLL registers as extras) and resume
     to the same result; resuming across sketched/unsketched is rejected."""
@@ -205,6 +212,7 @@ def test_sketched_checkpoint_resume(tmp_path, rng):
                             checkpoint_path=ck, checkpoint_every=2)
 
 
+@pytest.mark.slow
 def test_multi_file_corpus_counts_and_recovery(tmp_path, rng):
     """Three files streamed as one corpus: counts equal the concatenation's
     oracle, words recover exactly, checkpoints resume across file seams."""
@@ -296,6 +304,7 @@ def test_checkpoint_future_format_rejected(tmp_path):
         ckpt.load(p, template={"k": np.zeros(4, np.uint32)})
 
 
+@pytest.mark.slow
 def test_step_retry_recovers_transient_failure(tmp_path, rng, monkeypatch):
     """VERDICT r1 #5 'done' case: an injected one-shot step failure recovers
     via the in-memory known-good snapshot, without a checkpoint file, and
@@ -343,6 +352,7 @@ def test_step_retry_exhausted_surfaces(tmp_path, rng, monkeypatch):
         executor.count_file(str(path), cfg, mesh=data_mesh(2), retry=2)
 
 
+@pytest.mark.slow
 def test_mid_superstep_checkpoint_granularity(tmp_path, rng, monkeypatch):
     """VERDICT r1 #10 'done' case: with checkpoint_every finer than the
     superstep, a kill mid-run resumes from the last per-step checkpoint —
@@ -397,6 +407,7 @@ def test_mid_superstep_checkpoint_granularity(tmp_path, rng, monkeypatch):
     assert dict(zip(result.words, result.counts)) == oracle.word_counts(corpus)
 
 
+@pytest.mark.slow
 def test_merge_every_batched_equals_pairwise(tmp_path, rng):
     """merge_every=K folds K staged batch tables in one reduce: results must
     equal the K=1 pairwise fold — words, counts, totals, order — including
@@ -418,6 +429,7 @@ def test_merge_every_batched_equals_pairwise(tmp_path, rng):
     assert tk.as_dict() == t1.as_dict()
 
 
+@pytest.mark.slow
 def test_merge_every_under_capacity_pressure(tmp_path):
     """Under table spill the kept keys/counts and dropped_count stay
     identical; the dropped_uniques bound can only TIGHTEN (a respilled key
@@ -436,6 +448,7 @@ def test_merge_every_under_capacity_pressure(tmp_path):
     assert rk.dropped_uniques <= r1.dropped_uniques
 
 
+@pytest.mark.slow
 def test_merge_every_checkpoint_resume(tmp_path, rng):
     """The buffered state (pending arrays + cursor) snapshots and resumes
     exactly like any other state pytree."""

@@ -22,6 +22,7 @@ def _check(data: bytes, config: Config) -> None:
 
 @pytest.mark.parametrize("config", [XLA, PALLAS], ids=["xla", "pallas"])
 @pytest.mark.parametrize("seed", range(3))
+@pytest.mark.slow
 def test_random_full_alphabet(config, seed):
     """Random bytes over the FULL 0-255 alphabet: punctuation, UTF-8
     continuation bytes, NULs, and every separator class."""
@@ -49,6 +50,7 @@ def test_words_at_length_envelope(config):
     _check(data, config)
 
 
+@pytest.mark.slow
 def test_pallas_drops_only_overlong(rng):
     """Mixed stream: with rescue off, pallas == oracle minus tokens longer
     than W (the accounting contract); the default rescue counts them too
@@ -68,6 +70,7 @@ def test_pallas_drops_only_overlong(rng):
 
 
 @pytest.mark.parametrize("seed", range(4))
+@pytest.mark.slow
 def test_streamed_capacity_pressure_keeps_exact_totals(tmp_path, seed):
     """Randomized soak slice: under table-capacity pressure a streamed run
     keeps exact totals and every reported count exact (drops are accounted,
@@ -153,6 +156,7 @@ def test_fuzz_sample_totals_and_membership(tmp_path, seed):
 
 
 @pytest.mark.parametrize("seed", range(3))
+@pytest.mark.slow
 def test_fuzz_multigrep_singles_agreement(tmp_path, seed):
     """Random pattern sets over random corpora: the fused multi-pass must
     equal per-pattern runs, streamed, under random geometry."""
@@ -176,6 +180,7 @@ def test_fuzz_multigrep_singles_agreement(tmp_path, seed):
 
 
 @pytest.mark.parametrize("seed", range(4))
+@pytest.mark.slow
 def test_fuzz_streamed_ngrams_exact_random_geometry(tmp_path, seed):
     """Streamed n-grams == single-buffer under random corpus geometry:
     random chunk size, mesh width, gram order, separator statistics —

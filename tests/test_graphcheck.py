@@ -1,0 +1,264 @@
+"""graphcheck (mapreduce_tpu.analysis): the static analyzer's contract.
+
+Each of the four passes is demonstrated by a known-bad fixture job that
+must produce an error-severity finding (non-commutative merge, un-paired
+32-bit counter, callback-in-jit, collective over a mismatched axis), and a
+clean run over every built-in model must produce ZERO error findings —
+the acceptance criteria of the graphcheck issue, wired into tier-1.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mapreduce_tpu import analysis
+from mapreduce_tpu import models as models_mod
+from mapreduce_tpu.analysis import core as acore
+from mapreduce_tpu.analysis.passes.algebra import AlgebraPass
+from mapreduce_tpu.analysis.passes.hostsync import HostSyncPass
+from mapreduce_tpu.analysis.passes.overflow import OverflowPass
+from mapreduce_tpu.analysis.passes.sharding import ShardingPass
+from mapreduce_tpu.parallel.mesh import data_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return data_mesh(8)
+
+
+# -- known-bad fixture jobs (duck-typed MapReduceJobs) ----------------------
+
+
+class _ScalarJob:
+    """Minimal correct job: count non-pad bytes into one uint32 scalar.
+
+    Deliberately NOT named like a counter (state is a bare leaf), so the
+    overflow lint stays quiet and each fixture below isolates one pass.
+    """
+
+    def init_state(self):
+        return jnp.zeros((), jnp.uint32)
+
+    def map_chunk(self, chunk, chunk_id):
+        return jnp.sum((chunk != 0).astype(jnp.uint32))
+
+    def combine(self, state, update):
+        return state + update
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return state
+
+    def identity(self):
+        return type(self).__name__.lower()
+
+
+class NonCommutativeMergeJob(_ScalarJob):
+    """merge = a - b: the reducer-algebra property check must refuse it."""
+
+    def merge(self, a, b):
+        return a - b
+
+
+class Int32CounterState(NamedTuple):
+    count: jax.Array  # uint32 scalar, deliberately NOT lane-paired
+
+
+class Int32CounterJob(_ScalarJob):
+    """A corpus-scale counter in one un-paired uint32: the overflow lint
+    must flag it against a >2**32-token corpus bound."""
+
+    def init_state(self):
+        return Int32CounterState(count=jnp.zeros((), jnp.uint32))
+
+    def map_chunk(self, chunk, chunk_id):
+        return Int32CounterState(
+            count=jnp.sum((chunk != 0).astype(jnp.uint32)))
+
+    def combine(self, state, update):
+        return Int32CounterState(count=state.count + update.count)
+
+    def merge(self, a, b):
+        return Int32CounterState(count=a.count + b.count)
+
+
+class CallbackJob(_ScalarJob):
+    """A host callback inside the jitted map: the host-sync pass must
+    flag the per-dispatch device->host round trip."""
+
+    def map_chunk(self, chunk, chunk_id):
+        total = jnp.sum((chunk != 0).astype(jnp.uint32))
+        return jax.pure_callback(
+            lambda x: np.asarray(x, dtype=np.uint32),
+            jax.ShapeDtypeStruct((), np.uint32), total)
+
+
+class BadAxisJob(_ScalarJob):
+    """Reduces over a hardcoded axis name the mesh does not carry (the
+    mismatched-PartitionSpec case): the sharding lint must flag it."""
+
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        return jax.lax.psum(self.map_chunk(chunk, chunk_id), "replica")
+
+
+def _errors(report, pass_id):
+    return [f for f in report.errors if f.pass_id == pass_id]
+
+
+# -- one failing fixture per pass -------------------------------------------
+
+
+def test_algebra_pass_flags_noncommutative_merge(mesh8):
+    report = analysis.analyze_job(NonCommutativeMergeJob(), "bad-merge",
+                                  mesh=mesh8, passes=[AlgebraPass()])
+    errs = _errors(report, "reducer-algebra")
+    assert errs, report.format_text()
+    assert any("commutative" in f.message for f in errs)
+    assert report.exit_code != 0
+
+
+def test_algebra_pass_accepts_additive_merge(mesh8):
+    report = analysis.analyze_job(_ScalarJob(), "ok-merge", mesh=mesh8,
+                                  passes=[AlgebraPass()])
+    assert not report.errors, report.format_text()
+
+
+def test_overflow_pass_flags_unpaired_uint32_counter(mesh8):
+    report = analysis.analyze_job(Int32CounterJob(), "bad-counter",
+                                  mesh=mesh8, passes=[OverflowPass()],
+                                  corpus_bytes=1 << 40)  # ~2**39 tokens
+    errs = _errors(report, "overflow-dtype")
+    assert errs, report.format_text()
+    assert any("count" in f.location for f in errs)
+    assert report.exit_code != 0
+
+
+def test_overflow_pass_quiet_within_dtype_range(mesh8):
+    # A 1 GB corpus bound fits uint32 with room: no error, no warning.
+    report = analysis.analyze_job(Int32CounterJob(), "small-counter",
+                                  mesh=mesh8, passes=[OverflowPass()],
+                                  corpus_bytes=1 << 30)
+    assert not report.errors, report.format_text()
+    assert not report.by_severity(acore.WARNING), report.format_text()
+
+
+def test_overflow_pass_accepts_lane_paired_counters(mesh8):
+    job = models_mod.build_model("wordcount")
+    report = analysis.analyze_job(job, "wordcount", mesh=mesh8,
+                                  passes=[OverflowPass()],
+                                  corpus_bytes=1 << 50)  # 1 PiB
+    assert not report.errors, report.format_text()
+
+
+def test_hostsync_pass_flags_callback_in_jit(mesh8):
+    report = analysis.analyze_job(CallbackJob(), "bad-callback",
+                                  mesh=mesh8, passes=[HostSyncPass()])
+    errs = _errors(report, "host-sync")
+    assert errs, report.format_text()
+    assert any("callback" in f.message for f in errs)
+    assert report.exit_code != 0
+
+
+def test_sharding_pass_flags_mismatched_axis(mesh8):
+    report = analysis.analyze_job(BadAxisJob(), "bad-axis", mesh=mesh8,
+                                  passes=[ShardingPass()])
+    errs = _errors(report, "sharding-lint")
+    assert errs, report.format_text()
+    assert report.exit_code != 0
+
+
+def test_sharding_pass_accepts_engine_collectives(mesh8):
+    job = models_mod.build_model("grep")
+    report = analysis.analyze_job(job, "grep", mesh=mesh8,
+                                  passes=[ShardingPass()])
+    assert not report.errors, report.format_text()
+
+
+# -- clean run over every built-in model (the CI gate) ----------------------
+
+
+def test_all_builtin_models_are_clean(mesh8):
+    """Zero error-severity findings over the whole shipped model zoo."""
+    full = analysis.Report()
+    for name in models_mod.model_names():
+        job = models_mod.build_model(name)
+        one = analysis.analyze_job(job, model=name, mesh=mesh8)
+        full.models.extend(one.models)
+        full.extend(one.findings)
+    assert full.models == models_mod.model_names()
+    assert not full.errors, full.format_text()
+    assert full.exit_code == 0
+
+
+def test_cli_exits_zero_on_shipped_models(capsys):
+    from mapreduce_tpu.analysis.cli import main
+
+    rc = main(["wordcount", "--min-severity", "error"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "graphcheck" in out
+
+
+def test_cli_list(capsys):
+    from mapreduce_tpu.analysis.cli import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "wordcount" in out and "reducer-algebra" in out
+
+
+def test_cli_json_shape(capsys):
+    import json
+
+    from mapreduce_tpu.analysis.cli import main
+
+    rc = main(["grep", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == payload["exit_code"] == 0
+    assert payload["models"] == ["grep"]
+    for f in payload["findings"]:
+        assert {"severity", "pass_id", "model", "hook", "message",
+                "location", "hint"} <= set(f)
+
+
+# -- the pluggable registry -------------------------------------------------
+
+
+def test_custom_pass_registration(mesh8):
+    calls = []
+
+    class ProbePass:
+        pass_id = "probe"
+        description = "test-only"
+
+        def run(self, ctx):
+            calls.append(ctx.model)
+            return [acore.Finding(severity=acore.INFO, pass_id="probe",
+                                  model=ctx.model, hook="merge",
+                                  message="probe ran")]
+
+    report = analysis.analyze_job(_ScalarJob(), "probed", mesh=mesh8,
+                                  passes=[ProbePass()])
+    assert calls == ["probed"]
+    assert [f.pass_id for f in report.findings] == ["probe"]
+    assert report.exit_code == 0
+
+
+def test_report_ordering_and_severity_gate():
+    r = analysis.Report(models=["m"])
+    r.extend([
+        acore.Finding(severity=acore.INFO, pass_id="p", model="m",
+                      hook="h", message="i"),
+        acore.Finding(severity=acore.ERROR, pass_id="p", model="m",
+                      hook="h", message="e"),
+        acore.Finding(severity=acore.WARNING, pass_id="p", model="m",
+                      hook="h", message="w"),
+    ])
+    assert [f.severity for f in r.sorted_findings()] == \
+        ["error", "warning", "info"]
+    assert r.exit_code == 1

@@ -96,6 +96,7 @@ def test_streamed_grep_transparent_middle_rows_exact(tmp_path):
     assert r.lines == 1
 
 
+@pytest.mark.slow
 def test_streamed_grep_lines_exact_fuzz(tmp_path, rng):
     """Randomized cross-check of the exact-lines carry chain against the
     pure-Python oracle under many row geometries."""
@@ -128,6 +129,7 @@ def test_64bit_carry_accumulation():
     assert result.lines == 0xFFFFFFF0 + 0x20
 
 
+@pytest.mark.slow
 def test_grep_cli(tmp_path, capsys):
     from mapreduce_tpu import cli
 
@@ -234,6 +236,7 @@ def test_streamed_multi_file_grep_no_carry_leak(tmp_path):
     assert r2.lines == 2
 
 
+@pytest.mark.slow
 def test_multi_pattern_grep_matches_singles(tmp_path, small_corpus):
     """MultiGrepJob: P patterns in one pass must equal P single runs."""
     pats = [b"w1", b"w23", b"zqx", b"w1 w"]

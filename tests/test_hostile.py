@@ -8,7 +8,7 @@ envelope where the semantics intentionally bound work (force-split, rescue
 window).
 """
 
-import numpy as np
+
 import pytest
 
 from bench import make_markup_corpus
@@ -32,6 +32,7 @@ def _agree(data: bytes, pallas_cfg: Config = PALLAS):
     return rp
 
 
+@pytest.mark.slow
 def test_utf8_multibyte_words():
     """Continuation bytes (>= 0x80) are never separators: multibyte words
     stay whole, stay distinct from their prefixes, and report byte-exact."""
@@ -69,6 +70,7 @@ def test_nul_bearing_input():
     assert r.as_dict() == {b"alpha": 2, b"beta": 1, b"gamma": 1, b"delta": 1}
 
 
+@pytest.mark.slow
 def test_long_separator_free_run_force_split(tmp_path):
     """A separator-free run far beyond chunk_bytes: the reader force-splits
     (it must make progress), producing deterministic artificial token
@@ -91,6 +93,7 @@ def test_long_separator_free_run_force_split(tmp_path):
     assert frag_bytes == len(run)
 
 
+@pytest.mark.slow
 def test_markup_corpus_backends_agree():
     """The enwik-like markup generator (UTF-8, tags, entities, wiki links,
     URLs, long attribute blobs): pallas with DEFAULT flags (stable2 +
@@ -113,6 +116,7 @@ def test_markup_corpus_backends_agree():
     assert any(len(w) > 32 for w in rp.words)
 
 
+@pytest.mark.slow
 def test_markup_corpus_streamed_matches_buffered(tmp_path):
     from mapreduce_tpu.runtime.executor import count_file
 

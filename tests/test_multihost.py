@@ -69,6 +69,7 @@ def test_initialize_is_noop_on_single_host(monkeypatch):
     assert dist.is_coordinator()
 
 
+@pytest.mark.slow
 def test_per_host_byte_range_runs_merge_to_global_counts(tmp_path, rng):
     """The full documented multi-host flow, emulated in-process: each 'host'
     streams only its aligned [lo, hi) range (run_job byte_range), and the
@@ -108,6 +109,7 @@ def test_per_host_byte_range_runs_merge_to_global_counts(tmp_path, rng):
     assert int(np.asarray(merged.total_count())) == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_true_multiprocess_spmd_run(tmp_path):
     """VERDICT r1 #7: REAL multi-process multi-host — 2 worker processes
     join one JAX runtime via jax.distributed.initialize (gloo CPU
@@ -162,6 +164,7 @@ def test_true_multiprocess_spmd_run(tmp_path):
     assert got["processes"] == 2 and got["devices"] == 4
 
 
+@pytest.mark.slow
 def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     """VERDICT r3 #5 'done' case: the executor-level global-SPMD driver
     (run_job_global) runs REAL 2-process SPMD over gloo — global mesh,

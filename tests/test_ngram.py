@@ -65,6 +65,7 @@ def ngram_counts_by_tokens(data: bytes, n: int) -> dict[tuple, int]:
 
 
 @pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.slow
 def test_ngrams_match_oracle(small_corpus, n):
     cfg = Config(table_capacity=1 << 14)
     result = wordcount.count_ngrams(small_corpus, n, cfg)
@@ -110,6 +111,7 @@ def test_fewer_tokens_than_n():
     assert r.words == []
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_single_device_exact(tmp_path):
     """Streamed == single-buffer, bit-exact, on a one-device mesh whose
     2 KB chunks force grams to straddle every row seam (VERDICT r2 #5:
@@ -138,6 +140,7 @@ def test_streamed_ngrams_single_device_exact(tmp_path):
 
 
 @pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.slow
 def test_streamed_ngrams_multi_device_exact(tmp_path, n):
     """Streamed == single-buffer across an 8-device mesh: seams between
     devices within a step AND between steps, all exact."""
@@ -157,6 +160,7 @@ def test_streamed_ngrams_multi_device_exact(tmp_path, n):
     assert result.words == single.words
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_window_spans_three_chunks(tmp_path):
     """A separator run longer than a whole chunk leaves empty chunks between
     two tokens: the carry composes across them and the window completes at
@@ -176,6 +180,7 @@ def test_streamed_ngrams_window_spans_three_chunks(tmp_path):
     assert result.words == single.words  # spans include the 700-byte gaps
 
 
+@pytest.mark.slow
 def test_streamed_pallas_ngrams_exact_across_seams(tmp_path):
     """The pallas backend's streamed grams are exact across chunk seams too
     (summary extracted from the position-sorted packed stream)."""
@@ -195,6 +200,7 @@ def test_streamed_pallas_ngrams_exact_across_seams(tmp_path):
     assert result.words == single.words
 
 
+@pytest.mark.slow
 def test_ngram_checkpoint_order_mismatch(tmp_path, small_corpus):
     """Bigram and trigram states share shapes; job identity refuses the
     cross-resume."""
@@ -221,6 +227,7 @@ PALLAS_CFG = Config(chunk_bytes=128 * 66, table_capacity=1 << 14,
 
 
 @pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.slow
 def test_pallas_ngrams_match_oracle_and_xla(small_corpus, n):
     """The position-sort path produces bit-identical results to the XLA
     scan path (same hashes, same spans, same order)."""
@@ -314,6 +321,7 @@ def test_pallas_ngram_program_has_no_cond_fallback():
     assert jaxpr.count("cond[") == 1 and "pallas_call" in jaxpr
 
 
+@pytest.mark.slow
 def test_streamed_pallas_ngrams_match_xla_backend(tmp_path):
     """Streamed n-grams: pallas and xla backends over identical chunking
     must agree exactly (the per-chunk envelope is backend-independent)."""
@@ -379,6 +387,7 @@ def test_seam_carry_monoid_and_poison():
     assert int(dropped) == 2
 
 
+@pytest.mark.slow
 def test_streamed_sketched_ngrams_exact(tmp_path):
     """Sketch composition forwards the seam machinery: a distinct-sketch
     streamed bigram run still matches single-buffer totals exactly."""
@@ -399,6 +408,7 @@ def test_streamed_sketched_ngrams_exact(tmp_path):
     assert result.distinct_estimate == pytest.approx(single.distinct, rel=0.1)
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_top_k_with_seam_entries(tmp_path):
     """Device-side top_k over the streamed NGramState: seam entries
     (SEAM_GRAM_LENGTH) survive the terminal reorder and recover real spans;
@@ -426,6 +436,7 @@ def test_streamed_ngrams_top_k_with_seam_entries(tmp_path):
         assert exact.get(w) == c, w
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_multi_file_no_cross_file_grams(tmp_path):
     """Files are independent corpora: the seam carry resets at file
     boundaries (stacked-state-shaped reset), so no gram spans two files and
@@ -467,6 +478,7 @@ def test_seam_span_over_force_split_run(tmp_path):
     assert reader.scan_gram_lengths(str(path), [0], 2) == [5000 + 5]
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_superstep_exact(tmp_path):
     """Superstep (lax.scan) dispatch: each scan iteration is one step —
     its own summary gather + carry composition — so K-chunk supersteps
@@ -488,6 +500,7 @@ def test_streamed_ngrams_superstep_exact(tmp_path):
     assert result.words == single.words
 
 
+@pytest.mark.slow
 def test_streamed_ngrams_2d_mesh_exact(tmp_path):
     """Streamed n-grams on a 2-D ('replica','data') mesh: the summary
     all_gather over the axis TUPLE must order rows exactly like the
@@ -510,6 +523,7 @@ def test_streamed_ngrams_2d_mesh_exact(tmp_path):
     assert result.words == single.words
 
 
+@pytest.mark.slow
 def test_long_span_grams_recovered_exactly(tmp_path):
     """Gram spans >= 127 bytes (unbounded separator runs between tokens)
     exceed the packed build's 7-bit length field: the table stores the

@@ -98,6 +98,7 @@ def test_lane_seam_tokens(rng):
     _assert_tables_equal(want, got)
 
 
+@pytest.mark.slow
 def test_count_words_pallas_backend(rng):
     corpus = make_corpus(rng, n_words=1500, vocab=120)
     cfg = Config(chunk_bytes=128 * (2 * 32 + 2), table_capacity=CAP,
@@ -108,6 +109,7 @@ def test_count_words_pallas_backend(rng):
     assert result.total == oracle.total_count(corpus)
 
 
+@pytest.mark.slow
 def test_streaming_executor_pallas_backend(tmp_path, rng):
     """The full sharded streaming path (shard_map-traced pallas_call, padded
     rows, overlong accounting through merge) with backend='pallas'."""
@@ -125,9 +127,9 @@ def test_streaming_executor_pallas_backend(tmp_path, rng):
 
 
 def _interpret_mode():
-    from jax.experimental.pallas import tpu as pltpu
+    from tests.conftest import pallas_interpret_mode
 
-    return pltpu.force_tpu_interpret_mode()
+    return pallas_interpret_mode()
 
 
 def test_packed_bounds_validation():
@@ -149,7 +151,6 @@ def test_packed_stream_consistency(small_corpus):
     """PackedTokenStream's packed plane and total agree with its own
     reconstructed pos/length/count fields."""
     import numpy as np
-    from mapreduce_tpu import constants
     from mapreduce_tpu.ops import tokenize as tok_ops
     from mapreduce_tpu.ops.pallas import tokenize as pt
 
@@ -203,6 +204,7 @@ def test_compact_spill_detected_on_dense_text():
     assert spill > 0
 
 
+@pytest.mark.slow
 def test_compact_map_stream_falls_back_exactly(rng):
     """_map_stream's lax.cond: a spilling chunk silently reruns the full
     path — results must equal the XLA oracle for ANY density."""
@@ -223,6 +225,7 @@ def test_compact_map_stream_falls_back_exactly(rng):
         _assert_tables_equal(want, t)
 
 
+@pytest.mark.slow
 def test_compact_density_sweep_bit_identical(rng):
     """Log-shift compaction across the density spectrum: separator-heavy
     (long movement distances), long runs (overlong poison rows riding the
@@ -274,6 +277,7 @@ def test_compact_slots_validation():
             max_token_bytes=8, block_rows=64, interpret=True)  # > block/2
 
 
+@pytest.mark.slow
 def test_natural_corpus_backends_agree():
     """VERDICT r3 #6: on the natural-proxy corpus the pallas and xla
     backends must produce the SAME table — tools/density.py measured zero

@@ -40,6 +40,7 @@ def oracle():
 
 
 class TestRescueExact:
+    @pytest.mark.slow
     def test_matches_xla_oracle_counts_and_order(self, rng, oracle):
         longs = [b"x" * 40, b"y" * 100, b"z" * 150] * 3 + [b"u" * 63]
         text = _mixed_text(rng, long_words=longs)
@@ -67,6 +68,7 @@ class TestRescueExact:
         assert rp.as_dict() == rx.as_dict()
         assert rp.dropped_count == 0
 
+    @pytest.mark.slow
     def test_overlong_crossing_lane_seams(self, oracle):
         # A chunk-sized text where overlong tokens land on many different
         # lane-segment offsets, including straddling 128-lane seam bytes:
@@ -83,6 +85,7 @@ class TestRescueExact:
         assert rp.total == rx.total
         assert rp.dropped_count == 0
 
+    @pytest.mark.slow
     def test_with_compact_slots(self, oracle):
         rng = np.random.default_rng(9)
         longs = [b"q" * 50] * 5 + [b"r" * 120] * 2
@@ -105,6 +108,7 @@ class TestRescueEnvelope:
         assert rp.dropped_uniques == 3  # upper bound: unhashed, undedupable
         assert rp.total == rx.total  # accounting keeps totals exact
 
+    @pytest.mark.slow
     def test_budget_overflow_rescues_prefix_keeps_totals(self, rng):
         # More overlong tokens than BOTH tiers: the smallest positions win,
         # the rest stays accounted, totals stay exact.  Words are DISTINCT:
@@ -125,6 +129,7 @@ class TestRescueEnvelope:
         for w, c in rp.as_dict().items():
             assert ox[w] == c
 
+    @pytest.mark.slow
     def test_tier_escalates_past_primary_budget(self, rng):
         """VERDICT r4 weak #4: overlong counts past the primary budget
         escalate to the second tier under a lax.cond instead of silently
@@ -165,6 +170,7 @@ class TestRescueEnvelope:
         # An explicit primary budget above the auto cap is honored in full.
         assert Config(rescue_overlong=100000).rescue_slots_max == 100000
 
+    @pytest.mark.slow
     def test_rescue_off_keeps_round3_accounting(self, rng, oracle):
         text = _mixed_text(rng, long_words=[b"n" * 40] * 4)
         rp, rx = oracle(text, rescue_overlong=0)
@@ -203,6 +209,7 @@ class TestRescueConfig:
         with pytest.raises(ValueError, match="rescue_window"):
             Config(rescue_overlong=64, rescue_window=32)
 
+    @pytest.mark.slow
     def test_streamed_executor_rescues(self, tmp_path, rng):
         # The engine/executor path flows through the same _map_stream:
         # a multi-chunk streamed run must agree with the XLA oracle.

@@ -53,6 +53,7 @@ def test_sample_spread_over_corpus():
         assert in_q >= 10, f"quarter {q} got only {in_q} of 100 draws"
 
 
+@pytest.mark.slow
 def test_merge_associative_commutative(small_corpus):
     """Bottom-k merge order must not change the result (collective safety)."""
     import jax

@@ -17,6 +17,7 @@ battery at D=64 costs ~an hour of XLA compile and is not suite material.
 """
 
 import os
+import pytest
 import signal
 import subprocess
 import sys
@@ -24,6 +25,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_at_64_devices(tmp_path):
     n = int(os.environ.get("MAPREDUCE_SCALE_DEVICES", "64"))
     # The geometry subset fits well inside 30 min; the documented manual

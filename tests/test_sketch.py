@@ -87,6 +87,7 @@ def test_sketched_run_survives_table_overflow(tmp_path, rng):
     assert r.total == 4500  # exact totals survive overflow regardless
 
 
+@pytest.mark.slow
 def test_sketched_tokens_match_real_hashes(small_corpus):
     """The sketch keys are the tokenizer's real 64-bit hashes: duplicates
     across chunks must not inflate the estimate."""
@@ -206,6 +207,7 @@ def test_hash_word_matches_device_grams(small_corpus):
         assert sketch.hash_word(span) == (int(h), int(l)), span
 
 
+@pytest.mark.slow
 def test_count_sketch_composes_with_ngrams(tmp_path):
     """The PARITY claim the review flagged: ngram x count-sketch estimates
     must honor the never-under-estimate contract for span queries."""
@@ -223,6 +225,7 @@ def test_count_sketch_composes_with_ngrams(tmp_path):
     assert r.estimate_count(b"hello\tworld") == est
 
 
+@pytest.mark.slow
 def test_batched_sketch_updates_identical(tmp_path, rng):
     """sketch_flush_every=K stages updates and scatters every K steps; the
     final registers / CMS matrix must be bit-identical to K=1 (HLL max and
@@ -253,6 +256,7 @@ def test_batched_sketch_updates_identical(tmp_path, rng):
                 np.testing.assert_array_equal(got.cms, ref.cms)
 
 
+@pytest.mark.slow
 def test_batched_sketch_checkpoint_resume(tmp_path, rng):
     """A checkpoint taken mid-pending-buffer resumes to the same result."""
     from tests.conftest import make_corpus
@@ -276,6 +280,7 @@ def test_batched_sketch_checkpoint_resume(tmp_path, rng):
     assert resumed.as_dict() == full.as_dict()
 
 
+@pytest.mark.slow
 def test_batched_sketch_with_superstep(tmp_path, rng):
     """Flush cadence composes with lax.scan supersteps (cond inside scan)."""
     from tests.conftest import make_corpus

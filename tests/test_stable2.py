@@ -16,7 +16,6 @@ import pytest
 
 from mapreduce_tpu.config import Config
 from mapreduce_tpu.models import wordcount
-from mapreduce_tpu.ops import table as tbl
 from mapreduce_tpu.ops import tokenize as tok
 from mapreduce_tpu.ops.pallas import tokenize as ptok
 from mapreduce_tpu.utils import oracle
@@ -62,6 +61,7 @@ def test_lane_major_planes_are_position_ordered(rng):
     assert int(spill) == 0
 
 
+@pytest.mark.slow
 def test_lane_major_row_set_matches_slot_major(rng):
     """Lane-major changes only the ORDER of the compacted planes, never
     the row set: both layouts must contain exactly the same live
@@ -86,6 +86,7 @@ def test_lane_major_row_set_matches_slot_major(rng):
 
 
 @pytest.mark.parametrize("vocab,n_words", [(50, 2000), (500, 8000)])
+@pytest.mark.slow
 def test_stable2_bit_identical_to_sort3(rng, vocab, n_words):
     corpus = make_corpus(rng, n_words=n_words, vocab=vocab)
     with _interpret():
@@ -129,6 +130,7 @@ def test_stable2_spill_falls_back_exactly():
     assert r.total == 4000
 
 
+@pytest.mark.slow
 def test_stable2_streamed_executor(tmp_path, rng):
     """Streamed sort3 (8-device mesh) == stable2 (4-device mesh).
 
@@ -170,6 +172,7 @@ def test_stable2_config_validation():
     assert cfg.rescue_slots == 1024  # rescue rides stable2 too
 
 
+@pytest.mark.slow
 def test_stable2_first_occurrence_order(rng):
     """Insertion-order reporting (the reference's stdout contract) depends
     on exact first occurrences; construct a corpus where hot words first
@@ -188,11 +191,12 @@ def test_stable2_first_occurrence_order(rng):
 
 
 def _interpret():
-    from jax.experimental.pallas import tpu as pltpu
+    from tests.conftest import pallas_interpret_mode
 
-    return pltpu.force_tpu_interpret_mode()
+    return pallas_interpret_mode()
 
 
+@pytest.mark.slow
 def test_gram_build_bit_identical_across_sort_modes(rng):
     """The packed gram build (ops/ngram.py gram_table) honors sort_mode:
     stable2 (tie-order first occurrence, the default) and sort3 (third

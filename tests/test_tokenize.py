@@ -7,7 +7,6 @@ import pytest
 from mapreduce_tpu import constants
 from mapreduce_tpu.ops import tokenize as tok
 from mapreduce_tpu.utils import oracle
-from tests.conftest import make_corpus
 
 
 def _as_buf(data: bytes):
@@ -63,6 +62,7 @@ def test_prefix_words_hash_differently():
     assert len(keys) == 4
 
 
+@pytest.mark.slow
 def test_hash_collision_rate(rng):
     """64-bit keys over a 50k-word vocabulary: no collisions expected."""
     vocab = [f"word{i}" for i in range(50_000)]

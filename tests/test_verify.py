@@ -8,7 +8,7 @@ distinct words share a key — exactly the (astronomically rare) real failure,
 made reproducible.
 """
 
-import numpy as np
+
 import pytest
 
 from mapreduce_tpu.config import Config
@@ -37,6 +37,7 @@ def test_recount_exact_multi_file_and_unterminated_tail(tmp_path):
     assert got == {b"x": 3, b"y": 1, b"z": 1}
 
 
+@pytest.mark.slow
 def test_verify_result_passes_on_honest_run(tmp_path, rng):
     corpus = make_corpus(rng, n_words=4000, vocab=100)
     p = tmp_path / "c.txt"

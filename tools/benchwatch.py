@@ -29,7 +29,6 @@ probe is left to die on its own; each attempt spawns fresh.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import subprocess
 import sys

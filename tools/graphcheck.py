@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""graphcheck: certify map/reduce programs before they hit the TPU.
+
+Thin launcher for :mod:`mapreduce_tpu.analysis.cli` (also reachable as
+``python -m mapreduce_tpu.analysis``), runnable from a source checkout
+without installation.  Exits non-zero on any error-severity finding.
+
+Usage::
+
+    python tools/graphcheck.py --all-models
+    python tools/graphcheck.py wordcount grep --json
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mapreduce_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
