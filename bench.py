@@ -278,8 +278,11 @@ def _write_last_good(result: dict) -> None:
     # the listed harness knobs (which leave the measurement itself
     # unchanged) are headline-safe, so a future knob is refused by
     # default instead of silently clobbering.
+    # BENCH_LEDGER only redirects where telemetry is written; the measured
+    # run is unchanged.
     harness_only = {"BENCH_WATCHDOG_S", "BENCH_PROBE",
-                    "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE"}
+                    "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE",
+                    "BENCH_LEDGER"}
     if result.get("input") != "synthetic-zipf" or any(
             k.startswith("BENCH_") and k not in harness_only
             and os.environ.get(k) for k in os.environ):
@@ -543,6 +546,8 @@ def main() -> int:
         # pass over the corpus file; superstep amortizes dispatch latency
         # the same way production runs do.  BENCH_STREAMED=0 skips.
         streamed_gbps = None
+        streamed_ledger = None
+        streamed_metrics = None
         if os.environ.get("BENCH_STREAMED", "1") != "0":
             try:
                 import dataclasses
@@ -563,9 +568,28 @@ def main() -> int:
                                  mesh=mesh, byte_range=(0, warm_hi))
                 _log("streamed warm-up done (compile paid)", wall0)
                 _rearm_watchdog(streamed_budget, wall0)
+                # Telemetry on the TIMED pass only: the run ledger (one
+                # JSONL record per step: phase deltas, bytes, device mem,
+                # compile events) makes a bench row attributable after the
+                # fact — summarize with tools/obs_report.py.  BENCH_LEDGER
+                # overrides the path (benchwatch points it next to its
+                # per-step logs).
+                from mapreduce_tpu import obs
+
+                ledger_path = os.environ.get("BENCH_LEDGER") or os.path.join(
+                    tempfile.gettempdir(), f"bench_ledger.{os.getpid()}.jsonl")
+                tel = obs.Telemetry.create(ledger_path=ledger_path)
+                # The registry is process-global and already holds the
+                # headline + warm-up activity; snapshot here so the
+                # reported metrics are the DELTA over the timed pass only.
+                snap_before = obs.get_registry().snapshot()
                 t0 = time.perf_counter()
-                rr = executor.run_job(WordCountJob(s_cfg), path, config=s_cfg,
-                                      mesh=mesh)
+                try:
+                    rr = executor.run_job(WordCountJob(s_cfg), path,
+                                          config=s_cfg, mesh=mesh,
+                                          telemetry=tel)
+                finally:
+                    tel.close()
                 np.asarray(jax.tree.leaves(rr.value)[0].ravel()[:1])
                 s_dt = time.perf_counter() - t0
                 streamed_gbps = rr.metrics.bytes_processed / 1e9 / s_dt
@@ -576,10 +600,13 @@ def main() -> int:
                 # compute-bound), drain (queued compute at stream end).
                 streamed_phases = {k: round(v, 3)
                                    for k, v in rr.metrics.phases.items()}
+                streamed_ledger = ledger_path
+                streamed_metrics = _metrics_delta(
+                    snap_before, obs.get_registry().snapshot())
                 _log(f"streamed ingest pass done: {s_dt:.3f}s over "
                      f"{rr.metrics.bytes_processed >> 20} MB "
                      f"({streamed_gbps:.4f} GB/s end-to-end); "
-                     f"phases={streamed_phases}", wall0)
+                     f"phases={streamed_phases}; ledger={ledger_path}", wall0)
             except Exception as e:  # noqa: BLE001 — headline must survive
                 _log(f"streamed phase failed ({e!r}); keeping headline", wall0)
     finally:
@@ -589,9 +616,36 @@ def main() -> int:
     if streamed_gbps is not None:
         result["streamed_ingest_gbps"] = round(streamed_gbps, 4)
         result["streamed_phases"] = streamed_phases
+        if streamed_ledger:
+            result["ledger"] = streamed_ledger
+        # Registry DELTA over the timed streamed pass (the registry is
+        # process-global, so an absolute snapshot would fold in the
+        # headline + warm-up activity): steps/dispatches/prefetches and
+        # where the seconds pooled, machine-readable per round.
+        if streamed_metrics is not None:
+            result["metrics"] = streamed_metrics
     print(json.dumps(result))
     _write_last_good(result)
     return 0
+
+
+def _metrics_delta(before: dict, after: dict) -> dict:
+    """Counter and histogram count/sum deltas between two registry
+    snapshots (gauges are last-write-wins and pass through).  Histogram
+    min/max are window-less and deliberately dropped."""
+    b_c = before.get("counters", {})
+    counters = {k: round(v - b_c.get(k, 0), 6)
+                for k, v in after.get("counters", {}).items()
+                if v != b_c.get(k, 0)}
+    b_h = before.get("histograms", {})
+    hists = {}
+    for k, h in after.get("histograms", {}).items():
+        prev = b_h.get(k, {"count": 0, "sum": 0.0})
+        if h["count"] != prev["count"]:
+            hists[k] = {"count": h["count"] - prev["count"],
+                        "sum": round(h["sum"] - prev["sum"], 6)}
+    return {"counters": counters, "gauges": after.get("gauges", {}),
+            "histograms": hists}
 
 
 if __name__ == "__main__":

@@ -156,6 +156,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "pass over the corpus")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="with --stream: append a JSONL run ledger to PATH — "
+                        "one record per step/superstep carrying phase "
+                        "timings (read_wait/stage/dispatch), byte counts, "
+                        "device memory stats, compile events and retries; "
+                        "a failed run also dumps flight-recorder forensics "
+                        "to PATH.flight.json. Summarize with "
+                        "tools/obs_report.py")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="with --stream: write the end-of-run metrics-"
+                        "registry snapshot (executor/reader/checkpoint/"
+                        "collective counters, gauges, histograms) as JSON "
+                        "to PATH")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
                    help="'cpu' forces the run onto the host CPU even when the "
                         "environment pins JAX to an accelerator (equivalent "
@@ -230,7 +243,8 @@ def _print_stats(input_bytes: int, count: int, unit: str, elapsed: float) -> Non
           file=sys.stderr)
 
 
-def _grep_main(args, paths, data, config, input_bytes: int) -> int:
+def _grep_main(args, paths, data, config, input_bytes: int,
+               telemetry=None) -> int:
     """--grep mode: pattern counts instead of word counts.  Multiple --grep
     flags run as ONE fused pass (one ingest, P match masks)."""
     from mapreduce_tpu.models import grep
@@ -241,7 +255,7 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     syntax = args.grep_syntax
     kw = dict(config=config, syntax=syntax, checkpoint_path=args.checkpoint,
               checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
-              retry=args.retry)
+              retry=args.retry, telemetry=telemetry)
     t0 = time.perf_counter()
     try:
         with profiling.trace(args.profile):
@@ -294,7 +308,8 @@ def _grep_main(args, paths, data, config, input_bytes: int) -> int:
     return 0
 
 
-def _sample_main(args, paths, data, config, input_bytes: int) -> int:
+def _sample_main(args, paths, data, config, input_bytes: int,
+                 telemetry=None) -> int:
     """--sample mode: uniform token sample instead of counts."""
     from mapreduce_tpu.models import sample as sample_mod
     from mapreduce_tpu.runtime import profiling
@@ -307,7 +322,7 @@ def _sample_main(args, paths, data, config, input_bytes: int) -> int:
                     paths, args.sample, config=config,
                     checkpoint_path=args.checkpoint,
                     checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
-                    retry=args.retry)
+                    retry=args.retry, telemetry=telemetry)
             else:
                 result = sample_mod.sample_bytes(data, args.sample, config)
     except ValueError as e:
@@ -354,6 +369,10 @@ def main(argv: list[str] | None = None) -> int:
                      "(--distinct-sketch / --count-sketch / --estimate)")
     if args.checkpoint and not args.stream:
         parser.error("--checkpoint requires --stream")
+    if (args.ledger or args.metrics_out) and not args.stream:
+        # Honest failure beats a flag silently ignored: telemetry records
+        # the streaming executor's steps; the single-buffer path has none.
+        parser.error("--ledger/--metrics-out require --stream")
     if args.retry and not args.stream:
         parser.error("--retry requires --stream (the non-stream path has no "
                      "step dispatch to retry)")
@@ -496,10 +515,46 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {SEGMIN_TPU_ERROR}", file=sys.stderr)
             return 2
 
-    if args.grep is not None:
-        return _grep_main(args, paths, data, config, input_bytes)
-    if args.sample is not None:
-        return _sample_main(args, paths, data, config, input_bytes)
+    # One telemetry handle across every mode: the run ledger + flight
+    # recorder (--ledger) and the registry snapshot (--metrics-out).  The
+    # finally guarantees the snapshot and ledger flush land even when the
+    # run itself failed — a crashed telemetered run must leave evidence.
+    tel = None
+    if args.ledger or args.metrics_out:
+        from mapreduce_tpu import obs
+
+        try:
+            tel = obs.Telemetry.create(ledger_path=args.ledger)
+        except OSError as e:
+            print(f"error: cannot open ledger {args.ledger}: {e}",
+                  file=sys.stderr)
+            return 2
+    try:
+        if args.grep is not None:
+            return _grep_main(args, paths, data, config, input_bytes,
+                              telemetry=tel)
+        if args.sample is not None:
+            return _sample_main(args, paths, data, config, input_bytes,
+                                telemetry=tel)
+        return _wordcount_main(args, paths, data, config, input_bytes,
+                               telemetry=tel)
+    finally:
+        if tel is not None:
+            if args.metrics_out:
+                try:
+                    with open(args.metrics_out, "w") as f:
+                        json.dump(tel.registry.snapshot(), f, indent=1)
+                        f.write("\n")
+                except OSError as e:
+                    print(f"error: cannot write {args.metrics_out}: {e}",
+                          file=sys.stderr)
+            tel.close()
+
+
+def _wordcount_main(args, paths, data, config, input_bytes: int,
+                    telemetry=None) -> int:
+    """Default mode: word counts (the reference's contract)."""
+    from mapreduce_tpu.runtime import profiling
 
     t0 = time.perf_counter()
     try:
@@ -514,7 +569,7 @@ def main(argv: list[str] | None = None) -> int:
                                     merge_strategy=args.merge_strategy,
                                     checkpoint_path=args.checkpoint,
                                     checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
-                                    retry=args.retry)
+                                    retry=args.retry, telemetry=telemetry)
             else:
                 from mapreduce_tpu.models import wordcount
 

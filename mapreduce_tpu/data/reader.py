@@ -196,10 +196,21 @@ def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
     step N+1.  The producer thread is daemonic and bounded by a queue, so an
     abandoned consumer cannot leak unbounded memory; producer exceptions are
     re-raised at the consumer's next pull.
+
+    Wait accounting (ISSUE 2): the producer records per-batch production
+    time and time spent blocked on a FULL queue into the process metrics
+    registry.  Together with the executor's ``read_wait`` phase (consumer
+    blocked on an EMPTY queue) this classifies the pipeline — large
+    ``read_wait`` = reader-bound, large ``stall_full_queue`` = producer
+    comfortably ahead (device-bound).  Host-side dict updates only.
     """
     import queue
     import threading
+    import time as _time
 
+    from mapreduce_tpu.obs import registry as _obs_registry
+
+    reg = _obs_registry.get_registry()
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     _END, _ERR = object(), object()
@@ -216,9 +227,18 @@ def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
 
     def produce() -> None:
         try:
+            t_prev = _time.perf_counter()
             for b in batches:
+                t_ready = _time.perf_counter()
+                reg.observe("reader.produce_seconds", t_ready - t_prev)
+                reg.counter("reader.batches_prefetched").inc()
                 if not put(b):
                     return  # consumer abandoned the stream
+                t_prev = _time.perf_counter()
+                # put() returned: anything beyond the enqueue itself was
+                # blocking on a full queue — the producer running ahead.
+                reg.counter("reader.stall_full_queue_seconds").inc(
+                    t_prev - t_ready)
             put(_END)
         except BaseException as e:  # surfaced on the consumer side
             put((_ERR, e))

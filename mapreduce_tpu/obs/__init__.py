@@ -1,0 +1,31 @@
+"""Unified telemetry for the streaming runtime (ISSUE 2).
+
+One subsystem replacing three disconnected shims (``runtime/metrics.py``'s
+timers, ``runtime/logging.py``'s event lines, ``runtime/profiling.py``'s
+regions — all still used, now fed through one layer):
+
+* :mod:`.registry` — process-wide counters/gauges/histograms with labels;
+* :mod:`.ledger` — per-run JSONL step records (phase timings, bytes,
+  device memory, compile events, retries);
+* :mod:`.flight` — bounded ring of recent events, dumped with a state
+  summary on the failure path;
+* :mod:`.spans` — profiler-region + phase-timer spans so XProf timelines
+  line up with ledger records;
+* :mod:`.telemetry` — the facade the executor takes as ONE optional arg.
+
+Reporting: ``tools/obs_report.py`` renders a ledger/flight pair into a run
+summary with anomaly flags.  Schemas: ``docs/observability.md``.
+"""
+
+from mapreduce_tpu.obs.flight import FlightRecorder, summarize_state
+from mapreduce_tpu.obs.ledger import RunLedger, read_ledger
+from mapreduce_tpu.obs.registry import MetricsRegistry, get_registry
+from mapreduce_tpu.obs.spans import span
+from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
+                                         maybe)
+
+__all__ = [
+    "FlightRecorder", "MetricsRegistry", "RunLedger", "Telemetry",
+    "device_memory_stats", "get_registry", "maybe", "read_ledger", "span",
+    "summarize_state",
+]

@@ -1,0 +1,282 @@
+"""Telemetry facade: registry + run ledger + flight recorder as one handle.
+
+The executor takes ONE optional ``telemetry`` object instead of three
+shims; everything degrades together:
+
+* ``Telemetry.create(ledger_path=...)`` — full telemetry: JSONL ledger,
+  flight recorder armed, device-stat sampling, compile-event capture,
+  writing into the process-global metrics registry.
+* ``Telemetry.disabled()`` — the shared no-op instance (the default when a
+  caller passes ``telemetry=None``): every hot-path method returns
+  immediately on ``self.enabled``.  Disabled telemetry adds no per-step
+  host sync and no per-step allocation — the acceptance bar of ISSUE 2
+  (the graphcheck host-sync pass sees identical step programs either way,
+  because none of this lives inside jit).
+
+Device stats are sampled HOST-side only: ``device.memory_stats()`` is a
+PJRT metadata query and ``jax.live_arrays()`` enumerates already-tracked
+handles — neither blocks on device compute, so sampling at step cadence
+does not serialize the async dispatch pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from mapreduce_tpu.obs import flight as flight_mod
+from mapreduce_tpu.obs import ledger as ledger_mod
+from mapreduce_tpu.obs import registry as registry_mod
+
+# ---------------------------------------------------------------------------
+# Compile-event capture: jax reports compile durations through its
+# monitoring hooks; a process-wide listener fans them into the default
+# registry and into every live Telemetry's pending queue, so the next
+# ledger step record carries the compiles that landed since the previous
+# one (first-step records show the big trace+compile; later spikes reveal
+# recompile hazards).  Best-effort: the hook is jax-internal, so absence
+# degrades to "no compile events", never to a failure.
+# ---------------------------------------------------------------------------
+
+_LIVE: "set[Telemetry]" = set()
+_LIVE_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def _compile_listener(event: str, duration: float, **kw) -> None:
+    if "compile" not in event:
+        return
+    registry_mod.get_registry().observe("jax.compile_seconds", duration,
+                                        event=event)
+    with _LIVE_LOCK:
+        live = list(_LIVE)
+    for tel in live:
+        tel._pend_compile(event, duration)
+
+
+def _install_compile_listener() -> bool:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(_compile_listener)
+    except Exception:
+        return False
+    _LISTENER_INSTALLED = True
+    return True
+
+
+def device_memory_stats() -> dict:
+    """Best-effort host-side device memory snapshot.
+
+    Prefers the backend's ``memory_stats()`` (TPU/GPU: bytes_in_use, peak);
+    always adds the ``jax.live_arrays()`` aggregate, which is the only
+    signal the CPU backend has (its memory_stats is typically None).  Both
+    are metadata reads — no device sync.
+    """
+    out: dict = {}
+    try:
+        import jax
+
+        per_dev = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms:
+                per_dev.append(ms)
+        if per_dev:
+            out["bytes_in_use"] = int(sum(m.get("bytes_in_use", 0)
+                                          for m in per_dev))
+            peak = sum(m.get("peak_bytes_in_use", 0) for m in per_dev)
+            if peak:
+                out["peak_bytes_in_use"] = int(peak)
+            out["devices_reporting"] = len(per_dev)
+        arrs = jax.live_arrays()
+        out["live_arrays"] = len(arrs)
+        out["live_bytes"] = int(sum(getattr(a, "nbytes", 0) for a in arrs))
+    except Exception:
+        pass  # observing must never take down the observed run
+    return out
+
+
+class Telemetry:
+    """One handle over the three telemetry planes.  See module docstring."""
+
+    def __init__(self, *, enabled: bool = True,
+                 registry: Optional[registry_mod.MetricsRegistry] = None,
+                 ledger: Optional[ledger_mod.RunLedger] = None,
+                 flight: Optional[flight_mod.FlightRecorder] = None,
+                 flight_path: Optional[str] = None,
+                 sample_device_stats: bool = True):
+        self.enabled = enabled
+        self.registry = registry if registry is not None \
+            else registry_mod.get_registry()
+        self.ledger = ledger
+        self.flight = flight
+        self.flight_path = flight_path
+        self.sample_device_stats = sample_device_stats
+        self.run_id = ledger.run_id if ledger is not None \
+            else uuid.uuid4().hex[:12]
+        self._last_phases: dict = {}
+        self._last_record_t: Optional[float] = None
+        self._pending_compiles: list = []
+        self._pending_lock = threading.Lock()
+        if enabled:
+            _install_compile_listener()
+            with _LIVE_LOCK:
+                _LIVE.add(self)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, ledger_path: Optional[str] = None,
+               registry: Optional[registry_mod.MetricsRegistry] = None,
+               flight_capacity: int = flight_mod.DEFAULT_CAPACITY,
+               flight_path: Optional[str] = None,
+               run_id: Optional[str] = None) -> "Telemetry":
+        """Full telemetry.  ``flight_path`` defaults next to the ledger
+        (``<ledger>.flight.json``) so one flag leaves both artifacts."""
+        rid = run_id or uuid.uuid4().hex[:12]
+        ledger = ledger_mod.RunLedger(ledger_path, rid) if ledger_path else None
+        if flight_path is None and ledger_path:
+            flight_path = ledger_path + ".flight.json"
+        return cls(enabled=True, registry=registry, ledger=ledger,
+                   flight=flight_mod.FlightRecorder(flight_capacity),
+                   flight_path=flight_path)
+
+    _DISABLED: "Optional[Telemetry]" = None
+
+    @classmethod
+    def disabled(cls) -> "Telemetry":
+        """The shared no-op instance (zero per-step work)."""
+        if cls._DISABLED is None:
+            cls._DISABLED = cls(enabled=False, sample_device_stats=False)
+        return cls._DISABLED
+
+    # -- compile-event plumbing -------------------------------------------
+
+    def _pend_compile(self, event: str, duration: float) -> None:
+        with self._pending_lock:
+            self._pending_compiles.append((event, duration))
+
+    def _drain_compiles(self) -> dict:
+        """Pending compile events AGGREGATED per event type.  jax emits
+        hundreds of sub-millisecond trace events per program; the ledger
+        wants "this window compiled, and it cost N seconds", while the
+        registry histogram keeps the full distribution."""
+        with self._pending_lock:
+            pending, self._pending_compiles = self._pending_compiles, []
+        out: dict = {}
+        for event, duration in pending:
+            short = event.rsplit("/", 1)[-1]
+            agg = out.setdefault(short, {"count": 0, "seconds": 0.0})
+            agg["count"] += 1
+            agg["seconds"] += duration
+        for agg in out.values():
+            agg["seconds"] = round(agg["seconds"], 4)
+        return out
+
+    # -- event surface (all no-ops when disabled) --------------------------
+
+    def event(self, kind: str, **fields) -> None:
+        """Record into the flight ring (cheap; not a ledger write)."""
+        if self.enabled and self.flight is not None:
+            self.flight.record(kind, **fields)
+
+    def ledger_write(self, kind: str, **fields) -> None:
+        if self.enabled and self.ledger is not None:
+            self.ledger.write(kind, **fields)
+
+    def step_record(self, *, step_first: int, step_last: int,
+                    group_bytes: int, cursor_bytes: int, timer,
+                    retries: int = 0, write: bool = True) -> None:
+        """One ledger step record: phase-second DELTAS since the previous
+        record (the timer accumulates run totals), elapsed wall-clock,
+        device memory stats, and any compile events that landed in the
+        window.  ``write=False`` (non-coordinator processes in multi-host
+        runs) still advances the delta baseline so a later gate flip never
+        reports a cumulative blob as one step."""
+        if not self.enabled:
+            return
+        phases = {k: round(v - self._last_phases.get(k, 0.0), 6)
+                  for k, v in timer.phases.items()
+                  if v - self._last_phases.get(k, 0.0) > 0}
+        self._last_phases = dict(timer.phases)
+        now = time.perf_counter()
+        elapsed = None if self._last_record_t is None \
+            else round(now - self._last_record_t, 6)
+        self._last_record_t = now
+        compiles = self._drain_compiles()
+        steps = step_last - step_first + 1
+        self.registry.counter("executor.steps").inc(steps)
+        self.registry.counter("executor.dispatch_groups").inc()
+        self.registry.counter("executor.bytes_streamed").inc(group_bytes)
+        if "dispatch" in phases:
+            self.registry.observe("executor.dispatch_seconds",
+                                  phases["dispatch"])
+        self.event("step", step_first=step_first, step_last=step_last,
+                   cursor_bytes=cursor_bytes)
+        if not (write and self.ledger is not None):
+            return
+        mem = device_memory_stats() if self.sample_device_stats else {}
+        rec: dict[str, Any] = dict(step_first=step_first, step_last=step_last,
+                                   steps=steps, group_bytes=group_bytes,
+                                   cursor_bytes=cursor_bytes, phases=phases,
+                                   mem=mem)
+        if elapsed is not None:
+            rec["elapsed_s"] = elapsed
+        if retries:
+            rec["retries"] = retries
+        if compiles:
+            rec["compile_events"] = compiles
+        self.ledger.write("step", **rec)
+
+    def flight_dump(self, context: Optional[dict] = None,
+                    state: Any = None) -> Optional[str]:
+        """Dump the flight ring + state summary + registry snapshot.
+        Returns the dump path (None when telemetry is off or pathless).
+        Idempotent: the first failure of a run owns the file."""
+        if not (self.enabled and self.flight is not None and self.flight_path):
+            return None
+        summary = None
+        if state is not None:
+            try:
+                summary = flight_mod.summarize_state(state)
+            except Exception:
+                summary = {"error": "state summary failed"}
+        return self.flight.dump(self.flight_path, context=context,
+                                state_summary=summary,
+                                registry_snapshot=self.registry.snapshot())
+
+    def close(self) -> None:
+        """Flush/close the ledger and stop receiving compile events."""
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+        if self.ledger is not None:
+            self.ledger.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def maybe(telemetry: Optional[Telemetry]) -> Telemetry:
+    """Normalize an optional telemetry argument to a usable handle."""
+    return telemetry if telemetry is not None else Telemetry.disabled()
+
+
+def default_flight_path() -> str:
+    """Fallback dump location when a run has telemetry but no ledger path."""
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(),
+                        f"mapreduce-flight-{os.getpid()}.json")

@@ -33,11 +33,32 @@ from typing import Callable, TypeVar
 import jax
 import jax.numpy as jnp
 
+from mapreduce_tpu.obs import registry as obs_registry
 from mapreduce_tpu.ops import table as table_ops
 from mapreduce_tpu.parallel.compat import axis_size as _axis_size
 
 T = TypeVar("T")
 MergeFn = Callable[[T, T], T]
+
+
+def _count_build(strategy: str, axis) -> None:
+    """Trace-time collective accounting into the metrics registry.
+
+    These functions run INSIDE shard_map/jit, so per-execution timing from
+    here would require a host callback — exactly the per-step sync the
+    graphcheck host-sync pass forbids.  What IS observable host-side is
+    each strategy *build* (once per trace, i.e. per compiled program), with
+    its axis width: enough to see which reduce strategies a run compiled
+    and at what scale, and to correlate a compile-event spike in the run
+    ledger with the collective that caused it.  Execution cost belongs to
+    the profiler timeline (``obs.span`` regions around the dispatch).
+    """
+    try:
+        d = _axis_size(axis)
+    except Exception:
+        d = 0
+    obs_registry.get_registry().counter(
+        "collectives.builds", strategy=strategy, axis_size=d).inc()
 
 
 def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
@@ -47,6 +68,7 @@ def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
     n = _axis_size(axis)
     if n & (n - 1):
         return gather_merge(state, merge, axis)
+    _count_build("tree", axis)
     rounds = n.bit_length() - 1
     for r in range(rounds):
         bit = 1 << r
@@ -59,6 +81,7 @@ def tree_merge(state: T, merge: MergeFn, axis: str) -> T:
 def gather_merge(state: T, merge: MergeFn, axis: str) -> T:
     """all_gather every state then fold left.  Any axis size; replicated."""
     n = _axis_size(axis)
+    _count_build("gather", axis)
     gathered = jax.tree.map(lambda x: jax.lax.all_gather(x, axis), state)
     take = lambda i: jax.tree.map(lambda x: x[i], gathered)
     acc = take(0)
@@ -138,6 +161,7 @@ def key_range_merge(table: table_ops.CountTable, axis,
     cap = table.capacity
     if d == 1:
         return table
+    _count_build("keyrange", axis)
     b = min(cap, -(-int(slack * cap) // d) + 8 + 4 * (d - 1).bit_length())
     sent = jnp.uint32(table_ops.constants.SENTINEL_KEY)
     inf = jnp.uint32(table_ops.constants.POS_INF)

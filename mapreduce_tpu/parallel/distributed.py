@@ -40,11 +40,13 @@ the same program runs unmodified at every scale.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 
+from mapreduce_tpu.obs import registry as obs_registry
 from mapreduce_tpu.runtime.logging import get_logger, log_event
 
 
@@ -75,6 +77,11 @@ def initialize(coordinator_address: Optional[str] = None,
            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
     if not explicit and not env and not _on_cloud_tpu():
         return  # single-host run: nothing to join
+    # Init wall-clock into the registry: a pod bring-up that creeps from
+    # seconds to minutes (DNS, a slow peer, a flaky coordinator) shows up
+    # in every run's metrics snapshot instead of being lost to stderr.
+    reg = obs_registry.get_registry()
+    t0 = time.perf_counter()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -82,14 +89,19 @@ def initialize(coordinator_address: Optional[str] = None,
             process_id=process_id,
             initialization_timeout=timeout_s)
     except Exception as e:
+        reg.counter("distributed.init_failures").inc()
         log_event(get_logger(), "distributed initialization failed",
                   process_id=process_id, coordinator=coordinator_address or env,
                   error=repr(e))
         raise
+    init_s = time.perf_counter() - t0
+    reg.counter("distributed.inits").inc()
+    reg.gauge("distributed.init_seconds").set(init_s)
     log_event(get_logger(), "distributed runtime up",
               process=jax.process_index(), processes=jax.process_count(),
               local_devices=len(jax.local_devices()),
-              global_devices=len(jax.devices()))
+              global_devices=len(jax.devices()),
+              init_s=round(init_s, 3))
 
 
 def _is_initialized() -> bool:

@@ -20,10 +20,13 @@ import hashlib
 import json
 import os
 import tempfile
+import time
 from typing import Any
 
 import jax
 import numpy as np
+
+from mapreduce_tpu.obs import registry as obs_registry
 
 
 class CheckpointMismatch(RuntimeError):
@@ -113,6 +116,7 @@ def save(path: str, state: Any, step: int, offset: int,
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    t0 = time.perf_counter()
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
@@ -121,6 +125,16 @@ def save(path: str, state: Any, step: int, offset: int,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    # Checkpoint cadence cost, visible in the same snapshot as the stream
+    # phases (a save that rivals a superstep means checkpoint_every is too
+    # fine for the state size).
+    reg = obs_registry.get_registry()
+    reg.counter("checkpoint.saves").inc()
+    reg.observe("checkpoint.save_seconds", time.perf_counter() - t0)
+    try:
+        reg.counter("checkpoint.bytes_written").inc(os.path.getsize(path))
+    except OSError:
+        pass
 
 
 def load(path: str, template: Any = None,

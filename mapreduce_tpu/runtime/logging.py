@@ -26,16 +26,40 @@ class JsonFormatter(logging.Formatter):
         return json.dumps(obj)
 
 
-def get_logger(name: str = "mapreduce_tpu", json_lines: bool = False,
-               level: int = logging.INFO) -> logging.Logger:
+_TEXT_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str = "mapreduce_tpu", json_lines: bool | None = None,
+               level: int | None = None) -> logging.Logger:
+    """Named logger with the package's stderr handler attached once.
+
+    ``json_lines`` and ``level`` RECONFIGURE the existing handler when
+    passed explicitly; ``None`` (the default) keeps the current
+    configuration.  Before this was a sentinel, both arguments were
+    silently ignored on every call after the first (the handler was cached
+    with the first caller's settings — ISSUE 2 satellite): a CLI asking
+    for JSON lines after any library code had touched the logger kept
+    human-format forever.  First call defaults: text format, INFO.
+    """
     logger = logging.getLogger(name)
-    if not logger.handlers:
+    ours = [h for h in logger.handlers if getattr(h, "_mr_handler", False)]
+    if not ours:
         h = logging.StreamHandler(sys.stderr)
+        h._mr_handler = True
+        h._mr_json_lines = bool(json_lines)
         h.setFormatter(JsonFormatter() if json_lines else
-                       logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s"))
+                       logging.Formatter(_TEXT_FORMAT))
         logger.addHandler(h)
-        logger.setLevel(level)
+        logger.setLevel(logging.INFO if level is None else level)
         logger.propagate = False
+        return logger
+    h = ours[0]
+    if json_lines is not None and bool(json_lines) != h._mr_json_lines:
+        h._mr_json_lines = bool(json_lines)
+        h.setFormatter(JsonFormatter() if json_lines else
+                       logging.Formatter(_TEXT_FORMAT))
+    if level is not None:
+        logger.setLevel(level)
     return logger
 
 

@@ -14,7 +14,16 @@ import time
 
 @dataclasses.dataclass
 class PhaseTimer:
-    """Accumulates wall-clock per named phase."""
+    """Accumulates wall-clock per named phase.
+
+    ``stop`` is safe on a never-started (or already-stopped) phase: it
+    returns 0.0 and accumulates nothing.  Exception paths hit this
+    constantly — the executor's dispatch stops ``"dispatch"`` in a
+    ``finally`` that also runs when ``start`` itself never executed, and
+    the bare ``KeyError`` the old ``_open.pop(name)`` raised there would
+    REPLACE the real device failure being propagated (ISSUE 2 satellite).
+    Restarting an open phase discards the earlier start (last wins).
+    """
 
     phases: dict = dataclasses.field(default_factory=dict)
     _open: dict = dataclasses.field(default_factory=dict)
@@ -23,9 +32,15 @@ class PhaseTimer:
         self._open[name] = time.perf_counter()
 
     def stop(self, name: str) -> float:
-        dt = time.perf_counter() - self._open.pop(name)
+        t0 = self._open.pop(name, None)
+        if t0 is None:
+            return 0.0
+        dt = time.perf_counter() - t0
         self.phases[name] = self.phases.get(name, 0.0) + dt
         return dt
+
+    def running(self, name: str) -> bool:
+        return name in self._open
 
     def __getitem__(self, name: str) -> float:
         return self.phases.get(name, 0.0)

@@ -1,5 +1,7 @@
 """End-to-end streaming executor tests: count_file, checkpoint/resume, metrics."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -405,6 +407,83 @@ def test_mid_superstep_checkpoint_granularity(tmp_path, rng, monkeypatch):
         f"resume replayed from step {min(dispatched)}, not {crash_at['step']}"
     assert result.total == oracle.total_count(corpus)
     assert dict(zip(result.words, result.counts)) == oracle.word_counts(corpus)
+
+
+def test_ledger_one_record_per_step(tmp_path, rng):
+    """ISSUE 2 acceptance: a telemetered run writes >= 1 JSONL step record
+    per step, each with the phase decomposition (read_wait/stage/dispatch),
+    byte counts, and device memory stats; run_start/run_end bracket them."""
+    from mapreduce_tpu import obs
+
+    corpus = make_corpus(rng, 2000, 100)
+    path = _write(tmp_path, corpus)
+    led = str(tmp_path / "run.jsonl")
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        rr = executor.run_job(WordCountJob(CFG), path, CFG, mesh=data_mesh(4),
+                              telemetry=tel)
+    recs = list(obs.read_ledger(led))
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert steps, "at least one step record"
+    # superstep=1: exactly one record per step, contiguous from 0.
+    assert [r["step_first"] for r in steps] == list(range(len(steps)))
+    assert all(r["steps"] == 1 for r in steps)
+    assert sum(r["group_bytes"] for r in steps) == len(corpus)
+    assert steps[-1]["cursor_bytes"] == len(corpus)
+    for r in steps:
+        assert r["phases"].get("dispatch", 0) > 0
+        assert r["mem"].get("live_arrays", 0) > 0
+        assert r["mem"].get("live_bytes", 0) > 0
+    phase_keys = set().union(*(r["phases"] for r in steps))
+    assert {"read_wait", "stage", "dispatch"} <= phase_keys
+    # The step records decompose (within rounding) the run's stream phases.
+    end = recs[-1]
+    assert end["bytes"] == rr.metrics.bytes_processed == len(corpus)
+    total_dispatch = sum(r["phases"].get("dispatch", 0) for r in steps)
+    assert total_dispatch == pytest.approx(rr.metrics.phases["dispatch"],
+                                           rel=0.05)
+
+
+def test_flight_dump_on_injected_step_failure(tmp_path, rng, monkeypatch):
+    """ISSUE 2 acceptance: an injected step failure leaves a flight-recorder
+    dump (recent events + context + metrics) and a ledger failure record —
+    forensics instead of nothing (the benchwatch wedge scenario)."""
+    import json as _json
+
+    from mapreduce_tpu import obs
+    from mapreduce_tpu.parallel import mapreduce as mr
+
+    corpus = make_corpus(rng, 2000, 100)
+    path = _write(tmp_path, corpus)
+    original = mr.Engine.step
+
+    def failing(self, state, chunks, step_index):
+        if step_index >= 2:
+            raise RuntimeError("injected device fault")
+        return original(self, state, chunks, step_index)
+
+    monkeypatch.setattr(mr.Engine, "step", failing)
+    led = str(tmp_path / "run.jsonl")
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        with pytest.raises(RuntimeError, match="injected device fault"):
+            executor.run_job(WordCountJob(CFG), path, CFG, mesh=data_mesh(2),
+                             telemetry=tel)
+    dump_path = led + ".flight.json"
+    assert os.path.exists(dump_path), "failure must leave a flight dump"
+    with open(dump_path) as f:
+        dump = _json.load(f)
+    assert dump["context"]["step"] == 2
+    assert "injected device fault" in dump["context"]["error"]
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "step" in kinds and "step_failed" in kinds
+    assert dump["metrics"]["counters"]["executor.steps"] >= 2
+    # The ledger names the failure and points at the dump.
+    failures = list(obs.read_ledger(led, kind="failure"))
+    assert len(failures) == 1 and failures[0]["step"] == 2
+    assert failures[0]["flight_dump"] == dump_path
+    # No run_end: the crash is visible to obs_report as DID NOT COMPLETE.
+    assert not list(obs.read_ledger(led, kind="run_end"))
 
 
 @pytest.mark.slow

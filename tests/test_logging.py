@@ -1,0 +1,148 @@
+"""Direct tests for runtime/logging.py (previously zero) and the
+PhaseTimer safety fixes — ISSUE 2 satellites."""
+
+import json
+import logging
+
+from mapreduce_tpu.runtime.logging import (JsonFormatter, get_logger,
+                                           log_event)
+from mapreduce_tpu.runtime.metrics import PhaseTimer
+
+
+def _fmt(formatter, logger_name, msg, **fields):
+    rec = logging.LogRecord(logger_name, logging.INFO, __file__, 1, msg,
+                            None, None)
+    if fields:
+        rec.fields = fields
+    return formatter.format(rec)
+
+
+# -- JsonFormatter / log_event ---------------------------------------------
+
+def test_json_formatter_core_fields():
+    line = _fmt(JsonFormatter(), "t", "hello")
+    obj = json.loads(line)
+    assert obj["msg"] == "hello" and obj["level"] == "info"
+    assert isinstance(obj["ts"], float)
+
+
+def test_json_formatter_merges_event_fields():
+    obj = json.loads(_fmt(JsonFormatter(), "t", "step failed",
+                          step=3, offset=4096))
+    assert obj["step"] == 3 and obj["offset"] == 4096
+    assert obj["msg"] == "step failed"
+
+
+def test_log_event_attaches_fields():
+    logger = logging.getLogger("mapreduce_tpu.test_log_event")
+    logger.propagate = False
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger.addHandler(Capture())
+    logger.setLevel(logging.INFO)
+    try:
+        log_event(logger, "progress", step=7, bytes=123)
+    finally:
+        logger.handlers.clear()
+    assert records and records[0].fields == {"step": 7, "bytes": 123}
+    assert json.loads(JsonFormatter().format(records[0]))["step"] == 7
+
+
+# -- get_logger reconfigure (the handler-caching bug) -----------------------
+
+def _package_handler(logger):
+    return [h for h in logger.handlers if getattr(h, "_mr_handler", False)][0]
+
+
+def test_get_logger_honors_json_lines_after_first_call():
+    name = "mapreduce_tpu.test_reconf_json"
+    plain = get_logger(name)
+    assert isinstance(_package_handler(plain).formatter, logging.Formatter)
+    assert not isinstance(_package_handler(plain).formatter, JsonFormatter)
+    # The regression: this second call was silently ignored before.
+    jsonl = get_logger(name, json_lines=True)
+    assert jsonl is plain
+    assert isinstance(_package_handler(jsonl).formatter, JsonFormatter)
+    # ...and back.
+    get_logger(name, json_lines=False)
+    assert not isinstance(_package_handler(plain).formatter, JsonFormatter)
+
+
+def test_get_logger_honors_level_after_first_call():
+    name = "mapreduce_tpu.test_reconf_level"
+    logger = get_logger(name)
+    assert logger.level == logging.INFO
+    get_logger(name, level=logging.DEBUG)
+    assert logger.level == logging.DEBUG
+
+
+def test_get_logger_defaults_keep_configuration():
+    """The None defaults must NOT clobber an explicit earlier choice — a
+    library's bare get_logger() call after the CLI asked for JSON."""
+    name = "mapreduce_tpu.test_reconf_keep"
+    get_logger(name, json_lines=True, level=logging.WARNING)
+    again = get_logger(name)  # defaults: keep, not reset
+    assert isinstance(_package_handler(again).formatter, JsonFormatter)
+    assert again.level == logging.WARNING
+
+
+def test_get_logger_single_handler():
+    name = "mapreduce_tpu.test_reconf_single"
+    for _ in range(3):
+        logger = get_logger(name, json_lines=True)
+    assert len([h for h in logger.handlers
+                if getattr(h, "_mr_handler", False)]) == 1
+
+
+# -- PhaseTimer safety ------------------------------------------------------
+
+def test_phase_timer_stop_never_started_is_safe():
+    t = PhaseTimer()
+    assert t.stop("ghost") == 0.0  # formerly a bare KeyError
+    assert t["ghost"] == 0.0
+    assert "ghost" not in t.phases
+
+
+def test_phase_timer_double_stop_idempotent():
+    t = PhaseTimer()
+    t.start("a")
+    first = t.stop("a")
+    assert first >= 0.0
+    assert t.stop("a") == 0.0  # second stop accumulates nothing
+    assert t["a"] == first
+
+
+def test_phase_timer_restart_last_wins():
+    t = PhaseTimer()
+    t.start("a")
+    t.start("a")  # restart while open: earlier start discarded
+    dt = t.stop("a")
+    assert dt >= 0.0 and t["a"] == dt
+    assert not t.running("a")
+
+
+def test_phase_timer_nested_distinct_phases():
+    t = PhaseTimer()
+    t.start("outer")
+    t.start("inner")
+    assert t.running("outer") and t.running("inner")
+    t.stop("inner")
+    t.stop("outer")
+    assert t["outer"] >= t["inner"] >= 0.0
+
+
+def test_phase_timer_exception_path_preserves_cause():
+    """The executor stops 'dispatch' on the failure path; the stop must not
+    replace the propagating device error with a KeyError."""
+    t = PhaseTimer()
+    try:
+        try:
+            raise RuntimeError("device fault")
+        finally:
+            t.stop("dispatch")  # never started: start() itself failed
+    except RuntimeError as e:
+        assert "device fault" in str(e)

@@ -52,6 +52,10 @@ def run_step(out_path: str, name: str, cmd: list[str], env: dict,
     A stalled step is abandoned (left to finish and release its claim on
     its own) and reported as failed."""
     log(out_path, f"running {name}: {' '.join(cmd)}")
+    # Each bench step writes its run ledger next to its output capture, so
+    # a wedged window leaves per-step forensics (ledger + .flight.json)
+    # the next session can obs_report instead of a bare timeout line.
+    env = {**env, "BENCH_LEDGER": out_path + f".{name}.ledger.jsonl"}
     with open(out_path + f".{name}.out", "w") as stdout_f:
         proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=stdout_f,
                                 stderr=subprocess.STDOUT, text=True)

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the ROADMAP.md "Tier-1 verify" command, verbatim, as a
+# committed entry point (ISSUE 2 satellite) — so drivers, CI, and humans
+# run the exact same gate instead of re-typing it from the doc.
+#
+# Prints DOTS_PASSED=<n> (count of passing-test dots in the pytest tail)
+# and exits with pytest's status.  ~12 min on a 1-core box; the full suite
+# (no "-m 'not slow'") is the pre-release gate, not this one.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
