@@ -30,7 +30,16 @@ window is corpus*BENCH_REPEATS regardless), BENCH_CHUNK_MB (per-device
 step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
 (device passes over the resident corpus in the timed dispatch, default 8),
 BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
-BENCH_BASELINE_MB (CPU baseline slice, default 16).
+BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
+BENCH_SORT_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS (A/B knobs —
+measurement-altering, so BENCH_LAST_GOOD refuses them).
+
+BENCH_LAST_GOOD.json additionally carries per-metric BEST-KNOWN records
+(headline / streamed / h2d, each timestamped) alongside the last run; a
+metric regressing >25% under an otherwise-equal config cannot displace its
+best-known record unless BENCH_FORCE_LAST_GOOD=1 deliberately re-baselines
+(VERDICT r5 #2: a collapsed streamed number silently clobbered the only
+durable streamed evidence).  Every refused write logs to stderr.
 """
 
 from __future__ import annotations
@@ -265,10 +274,49 @@ _PARTIAL_RESULT: dict | None = None
 _WATCHDOG_DEADLINE: list = []  # single mutable slot: absolute deadline
 
 
+# The three metrics LAST_GOOD tracks value-aware best-known records for
+# (VERDICT r5 #2): result field -> record name.
+_BEST_METRICS = {"headline": "value", "streamed": "streamed_ingest_gbps",
+                 "h2d": "h2d_gbps"}
+# Context keys that must match for two records to count as "an
+# otherwise-equal config" (the corpus/knob gates above already exclude
+# cross-corpus and A/B-knob writes entirely).
+_BEST_CONTEXT = ("input", "devices", "backend", "corpus_mb")
+# A metric this far below its best-known record under an equal config is a
+# regression, not noise: the write of that record is refused (r5 shipped
+# exactly this — streamed 0.0088 -> 0.0028 at an equal 0.4276 headline —
+# and the regressed record clobbered the only durable streamed evidence).
+_REGRESSION_FRAC = 0.25
+
+
+def _log_refused(msg: str) -> None:
+    """Every refused last-good write leaves a stderr trace (ADVICE r5): a
+    missing record update must be diagnosable from the run log."""
+    print(f"[bench] last-good write refused: {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _same_config(rec: dict, result: dict) -> bool:
+    return all(rec.get(k) == result.get(k) for k in _BEST_CONTEXT)
+
+
+def _seed_best(prev: dict) -> dict:
+    """Bootstrap best-known records from a pre-round-6 (value-blind)
+    LAST_GOOD file so its evidence joins the new per-metric ledger."""
+    best = {}
+    for name, field in _BEST_METRICS.items():
+        if prev.get(field) is not None:
+            best[name] = {"value": prev[field],
+                          "recorded_at": prev.get("recorded_at"),
+                          **{k: prev.get(k) for k in _BEST_CONTEXT}}
+    return best
+
+
 def _write_last_good(result: dict) -> None:
     if result.get("backend") == "cpu":
         # A CPU smoke run must not clobber the TPU evidence a wedged later
         # round needs to fall back on.
+        _log_refused("cpu backend (smoke run, not TPU evidence)")
         return
     # A/B rows are evidence for BENCHMARKS.md, not the headline: letting
     # them overwrite LAST_GOOD makes the record look like a regression (a
@@ -278,19 +326,53 @@ def _write_last_good(result: dict) -> None:
     # the listed harness knobs (which leave the measurement itself
     # unchanged) are headline-safe, so a future knob is refused by
     # default instead of silently clobbering.
-    # BENCH_LEDGER only redirects where telemetry is written; the measured
-    # run is unchanged.
+    # BENCH_LEDGER only redirects where telemetry is written; the probe
+    # budget/timeout knobs only shape pre-measurement reachability retries
+    # (documented measurement-neutral at wait_for_device's call site);
+    # BENCH_FORCE_LAST_GOOD only changes what THIS function does.
     harness_only = {"BENCH_WATCHDOG_S", "BENCH_PROBE",
                     "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE",
-                    "BENCH_LEDGER"}
-    if result.get("input") != "synthetic-zipf" or any(
-            k.startswith("BENCH_") and k not in harness_only
-            and os.environ.get(k) for k in os.environ):
+                    "BENCH_LEDGER", "BENCH_RETRY_BUDGET_S",
+                    "BENCH_PROBE_TIMEOUT_S", "BENCH_FORCE_LAST_GOOD"}
+    if result.get("input") != "synthetic-zipf":
+        _log_refused(f"non-headline corpus {result.get('input')!r} "
+                     "(A/B evidence belongs in BENCHMARKS.md)")
         return
+    knobs = sorted(k for k in os.environ
+                   if k.startswith("BENCH_") and k not in harness_only
+                   and os.environ.get(k))
+    if knobs:
+        _log_refused(f"measurement-altering knob(s) set: {', '.join(knobs)}")
+        return
+    prev = _read_last_good() or {}
+    best = dict(prev.get("best") or _seed_best(prev))
+    force = os.environ.get("BENCH_FORCE_LAST_GOOD") == "1"
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    for name, field in _BEST_METRICS.items():
+        val = result.get(field)
+        if val is None:
+            continue
+        rec = best.get(name)
+        new_rec = {"value": val, "recorded_at": now,
+                   **{k: result.get(k) for k in _BEST_CONTEXT}}
+        if rec is None or val >= rec.get("value", 0.0):
+            best[name] = new_rec
+        elif force:
+            # Deliberate re-baseline (e.g. after a harness change made old
+            # records incomparable): the operator owns the downgrade.
+            best[name] = new_rec
+        elif val < (1.0 - _REGRESSION_FRAC) * rec["value"] \
+                and _same_config(rec, result):
+            _log_refused(
+                f"metric '{name}' regressed {rec['value']} -> {val} "
+                f"(> {_REGRESSION_FRAC:.0%}) under an otherwise-equal "
+                "config; best-known record kept "
+                "(BENCH_FORCE_LAST_GOOD=1 overrides)")
+        # Milder regressions (or config drift): best-known silently keeps
+        # the max — last-run fields below still record this run honestly.
     try:
         with open(LAST_GOOD_PATH, "w") as f:
-            json.dump({**result, "recorded_at": time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+            json.dump({**result, "recorded_at": now, "best": best}, f)
             f.write("\n")
     except OSError:
         pass  # read-only checkout: the caller already has the line
@@ -436,10 +518,15 @@ def main() -> int:
     # BENCH_SORT_MODE switches the aggregation sort strategy (sort3/segmin,
     # bit-identical results) and BENCH_MERGE_EVERY the table-merge cadence,
     # so live windows can A/B the sort floor and the merge amortization.
+    # BENCH_SORT_IMPL A/Bs the Pallas radix partition/sort against the XLA
+    # sort floor (BENCHMARKS.md round-6 pricing note; bit-identical
+    # results) — a measurement-altering knob, so LAST_GOOD refuses it.
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("BENCH_SORT_MODE",
                                           Config.sort_mode),
+                 sort_impl=os.environ.get("BENCH_SORT_IMPL",
+                                          Config.sort_impl),
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ
@@ -616,6 +703,9 @@ def main() -> int:
     if streamed_gbps is not None:
         result["streamed_ingest_gbps"] = round(streamed_gbps, 4)
         result["streamed_phases"] = streamed_phases
+        ratio = _streamed_ratio(result)
+        if ratio is not None:
+            result["streamed_vs_h2d_ratio"] = ratio
         if streamed_ledger:
             result["ledger"] = streamed_ledger
         # Registry DELTA over the timed streamed pass (the registry is
@@ -627,6 +717,18 @@ def main() -> int:
     print(json.dumps(result))
     _write_last_good(result)
     return 0
+
+
+def _streamed_ratio(result: dict) -> float | None:
+    """Streamed GB/s over the SAME-RUN H2D floor — the tunnel-invariant
+    form of the streamed metric (VERDICT r5 #3): relay weather moves both
+    numerator and denominator, so the ratio survives window quality where
+    the absolute GB/s does not.  None when either leg is missing/zero."""
+    streamed = result.get("streamed_ingest_gbps")
+    h2d = result.get("h2d_gbps")
+    if not streamed or not h2d:
+        return None
+    return round(streamed / h2d, 4)
 
 
 def _metrics_delta(before: dict, after: dict) -> dict:

@@ -15,10 +15,12 @@ over the wrong device group.  This pass checks, statically:
   ``axis_index``/``reduce_scatter``) reduces over axes bound by its
   enclosing ``shard_map`` scope AND present on the mesh
   (:mod:`mapreduce_tpu.parallel.collectives` contract: collectives must
-  be called inside ``shard_map``);
-* collectives over an axis the engine did NOT declare as a data axis on a
-  multi-axis mesh are WARNINGs (reducing over a strict subset of the
-  sharded axes is almost always a partial-merge bug).
+  be called inside ``shard_map``).
+
+NOT covered (open item, do not rely on it): a collective reducing over a
+strict SUBSET of a multi-axis mesh's declared data axes — the
+partial-merge hazard — passes this lint today; only unknown and unbound
+axis names are flagged.
 """
 
 from __future__ import annotations

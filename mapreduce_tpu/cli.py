@@ -128,6 +128,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "2-key sort; 'segmin' trades it for a segmented min "
                         "scan (CPU only — wedges the TPU). See "
                         "tools/sortbench.py")
+    p.add_argument("--sort-impl", choices=("xla", "radix", "radix_partition"),
+                   default="xla",
+                   help="aggregation sort implementation on the packed fast "
+                        "path (bit-identical results): 'xla' = lax.sort, "
+                        "the measured floor; 'radix_partition' / 'radix' = "
+                        "the Pallas MSD digit partition with per-bucket "
+                        "finishing sorts (1 / 2 digit levels; priced "
+                        "LOSING from measured rates, shipped for on-chip "
+                        "falsification — BENCHMARKS.md round 6). Like "
+                        "--sort-mode, applies to the packed fast path only "
+                        "(pallas wordcount family + gram builds); the xla "
+                        "wordcount path runs the generic build either way")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -457,6 +469,7 @@ def main(argv: list[str] | None = None) -> int:
                         pallas_max_token=args.max_token_bytes,
                         sketch_flush_every=args.sketch_flush_every,
                         sort_mode=args.sort_mode,
+                        sort_impl=args.sort_impl,
                         merge_every=args.merge_every,
                         compact_slots=args.compact_slots,
                         rescue_overlong=args.rescue_overlong,

@@ -131,6 +131,31 @@ class Config:
     # (tests/test_stable2.py) and an on-chip kernel parity smoke
     # (tools/kernel_smoke.py) holding both modes equal.
     sort_mode: str = "stable2"
+    # Aggregation sort IMPLEMENTATION for the packed fast path — orthogonal
+    # to sort_mode, which picks the comparator STRATEGY.  'xla' (default):
+    # jax.lax.sort, measured at 2.6-3.4 effective HBM passes on the 11.2M-
+    # row stream (BENCHMARKS.md round-6 pricing note).  'radix_partition':
+    # one Pallas MSD digit partition (per-block VMEM bucket compaction into
+    # static slabs + SMEM histograms, ops/pallas/radix.py) finished by
+    # per-bucket blocked XLA sorts.  'radix': two digit levels before the
+    # (smaller) finishing sorts.  Both radix modes are bit-identical to
+    # 'xla' — stable tie order included; adversarial bucket skew falls back
+    # to the XLA sort under a lax.cond (the compact-path spill idiom) — and
+    # serve sort3 and stable2 alike (ties resolve by `packed`, which is
+    # sort3's definition and stable2's tie order under its position-ordered
+    # input precondition).  The round-6 pricing from measured rates has
+    # them LOSING 2-3x (static slabs pay a slack-factor write amplification
+    # that only hardware scatter could avoid, and TPU has none), so 'xla'
+    # stays default until an on-chip window falsifies the arithmetic —
+    # benchwatch carries the A/B rows.  segmin is xla-only (its scan
+    # recovery needs packed as an unordered payload).  Scope — the same as
+    # sort_mode's: the PACKED fast path only, i.e. the pallas wordcount
+    # family and the packed gram build on both backends; the xla
+    # wordcount path runs the generic 7-array build, where neither knob
+    # applies (an xla-backend wordcount A/B of sort impls measures the
+    # same generic sort twice — run radix A/Bs on the pallas path, as
+    # bench.py does).
+    sort_impl: str = "xla"
     # Slot-compact the pallas kernel's column planes to S output rows per
     # block_rows-byte (block, lane) window instead of the pair path's
     # block_rows/2 (VERDICT r4 #2: the sort floor is row-count-bound).  At
@@ -188,6 +213,14 @@ class Config:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.sort_mode not in ("sort3", "stable2", "segmin"):
             raise ValueError(f"unknown sort_mode {self.sort_mode!r}")
+        if self.sort_impl not in ("xla", "radix", "radix_partition"):
+            raise ValueError(f"unknown sort_impl {self.sort_impl!r}")
+        if self.sort_impl != "xla" and self.sort_mode == "segmin":
+            raise ValueError(
+                "sort_impl='radix'/'radix_partition' requires sort_mode "
+                "'sort3' or 'stable2': segmin recovers first occurrence "
+                "with a segmented scan over packed-as-payload, an order "
+                "the radix path's tie-by-packed contract replaces")
         if self.sort_mode == "stable2" and self.compact_slots is not None \
                 and self.compact_slots != 128:
             # Mosaic requires the last block dim divisible by 128, and the

@@ -22,6 +22,16 @@ from mapreduce_tpu.config import Config
 ANALYSIS_CONFIG = Config(chunk_bytes=1 << 10, table_capacity=512,
                          backend="xla")
 
+# Radix-sort-impl wordcount (round 6): the production-shaped pallas program
+# with the Pallas radix partition in the aggregation seam, at the smallest
+# chunk the pallas backend admits (whole lane segments of 2W+2 bytes) —
+# registered so the graphcheck gate (hostsync / sharding / overflow /
+# algebra passes) certifies the radix program before dispatch like every
+# other shipped family.
+RADIX_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 66, table_capacity=512,
+                               backend="pallas",
+                               sort_impl="radix_partition")
+
 
 def _wordcount(config: Config):
     from mapreduce_tpu.models.wordcount import WordCountJob
@@ -55,12 +65,23 @@ def _sketch(config: Config):
     return SketchedWordCountJob(WordCountJob(config))
 
 
+def _wordcount_radix(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config like grep's pinned pattern: the model EXISTS to put the
+    # radix program in front of the analysis passes, so the caller's sizing
+    # config is deliberately ignored.
+    del config
+    return WordCountJob(RADIX_ANALYSIS_CONFIG)
+
+
 _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount": _wordcount,
     "grep": _grep,
     "sample": _sample,
     "ngram": _ngram,
     "sketch": _sketch,
+    "wordcount_radix": _wordcount_radix,
 }
 
 
@@ -78,4 +99,5 @@ def build_model(name: str, config: Config = ANALYSIS_CONFIG):
     return factory(config)
 
 
-__all__ = ["ANALYSIS_CONFIG", "build_model", "model_names"]
+__all__ = ["ANALYSIS_CONFIG", "RADIX_ANALYSIS_CONFIG", "build_model",
+           "model_names"]

@@ -189,7 +189,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 stream, capacity, pos_hi=pos_hi,
                 max_token_bytes=config.pallas_max_token,
                 max_pos=int(chunk.shape[0]), sort_mode=concat_sort_mode,
-                rescue_slots=config.rescue_slots_max)
+                rescue_slots=config.rescue_slots_max,
+                sort_impl=config.sort_impl)
             if not config.rescue_slots:
                 return accounted(built, overlong)
             t, rescue_packed = built
@@ -213,7 +214,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 col, capacity, pos_hi=pos_hi,
                 max_token_bytes=config.pallas_max_token,
                 max_pos=int(chunk.shape[0]), sort_mode="stable2",
-                rescue_slots=config.rescue_slots_max)
+                rescue_slots=config.rescue_slots_max,
+                sort_impl=config.sort_impl)
             seam_tbl = table_ops.from_stream(
                 seam,
                 min(capacity,
@@ -369,7 +371,8 @@ def _ngram_step(data: jax.Array, capacity: int, n: int,
         return ngram_ops.ngram_table(data, n, capacity, 0, config)
     gs = ngram_ops.mark_long_spans(tok_ops.ngrams(tok_ops.tokenize(data), n))
     return ngram_ops.gram_table(gs, capacity, 0, max_pos=data.shape[0],
-                                sort_mode=config.sort_mode)
+                                sort_mode=config.sort_mode,
+                                sort_impl=config.sort_impl)
 
 
 def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCountResult:
@@ -648,7 +651,8 @@ class NGramCountJob(WordCountJob):
             tok_ops.ngrams(tok_ops.tokenize(chunk), self.n))
         return ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
                                     max_pos=chunk.shape[0],
-                                    sort_mode=self.config.sort_mode)
+                                    sort_mode=self.config.sort_mode,
+                                    sort_impl=self.config.sort_impl)
 
     # -- exact cross-chunk grams (streamed runs) ----------------------------
 
@@ -677,7 +681,8 @@ class NGramCountJob(WordCountJob):
             gs = ngram_ops.mark_long_spans(tok_ops.ngrams(stream, self.n))
             t = ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
                                      max_pos=chunk.shape[0],
-                                     sort_mode=self.config.sort_mode)
+                                     sort_mode=self.config.sort_mode,
+                                     sort_impl=self.config.sort_impl)
             summ = ngram_ops.summary_from_stream(stream, chunk_id, self.n)
         gathered = jax.lax.all_gather(summ, axis_name=axis)  # leaves [D, n-1]
         return NGramUpdate(batch=t, summaries=gathered,

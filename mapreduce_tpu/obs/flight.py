@@ -89,11 +89,14 @@ def summarize_state(state) -> dict:
     leaves = jax.tree.leaves(state)
     out = {"n_leaves": len(leaves), "leaves": []}
     total = 0
-    for leaf in leaves[:64]:  # bound the dump size for huge pytrees
+    # total_nbytes covers EVERY leaf (it is what an OOM triage reads);
+    # only the per-leaf detail list is capped to bound the dump size.
+    for i, leaf in enumerate(leaves):
         arr = np.asarray(leaf)
         total += arr.nbytes
-        out["leaves"].append({"shape": list(arr.shape),
-                              "dtype": str(arr.dtype),
-                              "nbytes": int(arr.nbytes)})
+        if i < 64:
+            out["leaves"].append({"shape": list(arr.shape),
+                                  "dtype": str(arr.dtype),
+                                  "nbytes": int(arr.nbytes)})
     out["total_nbytes"] = int(total)
     return out

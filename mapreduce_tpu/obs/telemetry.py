@@ -25,6 +25,7 @@ import os
 import threading
 import time
 import uuid
+import weakref
 from typing import Any, Optional
 
 from mapreduce_tpu.obs import flight as flight_mod
@@ -41,7 +42,10 @@ from mapreduce_tpu.obs import registry as registry_mod
 # degrades to "no compile events", never to a failure.
 # ---------------------------------------------------------------------------
 
-_LIVE: "set[Telemetry]" = set()
+# Weak refs: a Telemetry handle dropped without close() must become
+# garbage, not a process-lifetime leak accumulating compile events via
+# the listener below (close() still removes deterministically).
+_LIVE: "weakref.WeakSet[Telemetry]" = weakref.WeakSet()
 _LIVE_LOCK = threading.Lock()
 _LISTENER_INSTALLED = False
 

@@ -140,7 +140,8 @@ def mark_long_spans(stream: TokenStream) -> TokenStream:
 
 
 def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
-               max_pos: int, sort_mode: str = "stable2") -> table_ops.CountTable:
+               max_pos: int, sort_mode: str = "stable2",
+               sort_impl: str = "xla") -> table_ops.CountTable:
     """Aggregate a position-ordered gram stream into a count table.
 
     Both backends' gram streams arrive in ascending start-position order
@@ -161,18 +162,30 @@ def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
     span the whole chunk).
     """
     # pos << 7 needs pos < 2**25; the padded chunk length is a trace-time
-    # constant, so the gate is static.
+    # constant, so the gate is static.  (The generic fallback ignores
+    # sort_impl: the radix seam covers the packed build only.)
     if max_pos > (1 << 25):
         return table_ops.from_stream(gs, capacity, pos_hi=pos_hi)
+    # Sentinel-collision proof (ADVICE r5): a live row packs to
+    # _SENT_PACKED only with pos == 2**25-1 AND len7 == 127 simultaneously
+    # — but len7 == 127 means the true span is >= 127 bytes
+    # (mark_long_spans stores min(span, 127)), so pos + 127 <= span end <=
+    # max_pos <= 2**25, i.e. pos <= 2**25 - 127 < 2**25 - 1.
+    # Contradiction: the collision is unreachable at ANY admitted max_pos.
+    # (Tightening the gate to `>=` instead would silently kick the
+    # production 32 MB chunk — padded length exactly 2**25 — onto the
+    # 2.3x-costlier generic build.)  The static assert pins the premise.
+    assert max_pos <= (1 << 25), max_pos
     live = gs.count > 0
     len7 = jnp.minimum(gs.length, jnp.uint32(127))
     packed = jnp.where(live, (gs.pos << jnp.uint32(7)) | len7, _SENT_PACKED)
     # sort_mode passes through unchanged: stable2's position-order
     # precondition holds here (docstring), sort3/segmin have none, and
-    # from_packed_rows owns the segmin-on-TPU refusal.
+    # from_packed_rows owns the segmin-on-TPU refusal.  sort_impl rides
+    # along so the gram family inherits the radix A/B with no extra knob.
     t = table_ops.from_packed_rows(
         gs.key_hi, gs.key_lo, packed, jnp.sum(gs.count), capacity, pos_hi,
-        len_bits=7, sort_mode=sort_mode)
+        len_bits=7, sort_mode=sort_mode, sort_impl=sort_impl)
     occ = t.occupied()
     return t._replace(length=jnp.where(
         occ & (t.length == jnp.uint32(127)),
@@ -208,7 +221,7 @@ def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
     key_hi, key_lo, packed = position_sorted(stream)
     gs = mark_long_spans(grams_from_sorted(key_hi, key_lo, packed, n))
     t = gram_table(gs, capacity, pos_hi, max_pos=chunk.shape[0],
-                   sort_mode=config.sort_mode)
+                   sort_mode=config.sort_mode, sort_impl=config.sort_impl)
     # Live sorted rows = real tokens + one poison row per overlong end.
     all_tokens = stream.total + overlong
     nm1 = jnp.uint32(n - 1)

@@ -318,7 +318,7 @@ def _build(key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length,
 def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
                      total: jax.Array, capacity: int, pos_hi: jax.Array | int,
                      len_bits: int = 6, sort_mode: str = "sort3",
-                     rescue_slots: int = 0):
+                     rescue_slots: int = 0, sort_impl: str = "xla"):
     """Aggregate pre-packed single-occurrence rows (the sort-lean path).
 
     ``packed`` = ``pos << len_bits | length`` per live row (all-ones for
@@ -365,11 +365,26 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     order the poison segment (packed rides as payload in arbitrary
     order), so that combination is rejected.
 
+    ``sort_impl`` picks the sort IMPLEMENTATION behind ``sort_mode``
+    (``Config.sort_impl``): ``'xla'`` is ``jax.lax.sort``; ``'radix'`` /
+    ``'radix_partition'`` route the stream through the Pallas radix
+    partition (:func:`mapreduce_tpu.ops.pallas.radix.radix_sort3`), whose
+    tie-by-``packed`` contract is bit-identical to sort3 outright and to
+    stable2 under its position-ordered-input precondition — so ONE radix
+    implementation serves both modes, poison-segment rescue extraction
+    included.  segmin is xla-only.
+
     Matches :func:`_build` output bit-for-bit under its preconditions (every
     live row has count 1, one shared pos_hi).
     """
     if sort_mode not in ("sort3", "stable2", "segmin"):
         raise ValueError(f"unknown sort_mode {sort_mode!r}")
+    if sort_impl not in ("xla", "radix", "radix_partition"):
+        raise ValueError(f"unknown sort_impl {sort_impl!r}")
+    if sort_impl != "xla" and sort_mode == "segmin":
+        raise ValueError("sort_impl='radix'/'radix_partition' requires "
+                         "sort_mode='sort3' or 'stable2' (segmin keeps "
+                         "packed as an unordered payload)")
     if rescue_slots and sort_mode == "segmin":
         raise ValueError("rescue_slots requires sort_mode='sort3' or "
                          "'stable2' (poison extraction needs the poison "
@@ -399,6 +414,18 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
             return xb | yb, jnp.where(yb, yv, jnp.minimum(xv, yv))
 
         _, run_min = jax.lax.associative_scan(_min_combine, (boundary, packed))
+    elif sort_impl != "xla":
+        # Radix path (Config.sort_impl): bit-identical to BOTH branches
+        # below — ties resolve by `packed`, which is sort3's third
+        # comparator key outright and, under stable2's position-ordered
+        # input, exactly the tie order stability delivers.  Adversarial
+        # bucket skew falls back to the XLA sort inside radix_sort3.
+        from mapreduce_tpu.ops.pallas import radix as radix_ops
+
+        key_hi, key_lo, packed = radix_ops.radix_sort3(
+            key_hi, key_lo, packed, impl=sort_impl)
+        _, rank = _segment_boundaries(key_hi, key_lo)
+        run_min = None
     elif sort_mode == "stable2":
         # Stable two-key sort, packed as PAYLOAD: ties keep input order, so
         # with position-ordered input each segment's head row carries the
@@ -468,7 +495,8 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
 
 def _from_stream_packed(stream: TokenStream, capacity: int,
                         pos_hi: jax.Array | int,
-                        sort_mode: str = "sort3", rescue_slots: int = 0):
+                        sort_mode: str = "sort3", rescue_slots: int = 0,
+                        sort_impl: str = "xla"):
     """Packed fast path for token streams: see :func:`from_packed_rows`."""
     # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
     # feed their raw plane straight into the sort — repacking from
@@ -484,13 +512,15 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
         total = jnp.sum(stream.count)
     return from_packed_rows(stream.key_hi, stream.key_lo, packed, total,
                             capacity, pos_hi, len_bits=6,
-                            sort_mode=sort_mode, rescue_slots=rescue_slots)
+                            sort_mode=sort_mode, rescue_slots=rescue_slots,
+                            sort_impl=sort_impl)
 
 
 def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
                 max_token_bytes: int | None = None,
                 max_pos: int | None = None,
-                sort_mode: str = "sort3", rescue_slots: int = 0):
+                sort_mode: str = "sort3", rescue_slots: int = 0,
+                sort_impl: str = "xla"):
     """Aggregate a per-byte :class:`TokenStream` into a fresh table.
 
     ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
@@ -503,12 +533,15 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
     the generic build; results are identical.  ``sort_mode`` picks that
     path's sort strategy (:func:`from_packed_rows`); ``rescue_slots`` (fast
     path only — the generic build has no poison rows to extract) makes the
-    return ``(table, rescue_packed)``.
+    return ``(table, rescue_packed)``.  ``sort_impl`` picks the fast
+    path's sort implementation (:func:`from_packed_rows`); the generic
+    7-array build below keeps ``lax.sort`` — the radix seam covers the
+    packed stream, which is the measured single-chip floor.
     """
     if (max_token_bytes is not None and max_token_bytes <= 63
             and max_pos is not None and max_pos <= (1 << 26)):
         return _from_stream_packed(stream, capacity, pos_hi, sort_mode,
-                                   rescue_slots)
+                                   rescue_slots, sort_impl)
     if rescue_slots:
         raise ValueError("rescue_slots requires the packed fast path "
                          "(bounded max_token_bytes/max_pos)")

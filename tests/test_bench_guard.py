@@ -1,0 +1,140 @@
+"""bench.py evidence guards: the value-aware BENCH_LAST_GOOD record
+(VERDICT r5 #2 — the round-5 clobber replayed exactly) and the
+link-normalized streamed metric (VERDICT r5 #3).
+
+Pure host tests: bench.py's guard functions are jax-free, so these run in
+milliseconds and live in tier-1.
+"""
+
+import json
+import os
+
+import pytest
+
+import bench
+
+# The round-5 records, verbatim shapes (BENCHMARKS.md round 5 / VERDICT
+# r5 weak #1): the 11:55Z run held streamed 0.0088; the later driver run
+# reproduced the headline (0.4276 vs 0.4275) but streamed collapsed 3.1x
+# to 0.0028 — and overwrote the record.  The guard must keep 0.0088.
+R5_GOOD = {
+    "metric": "zipf_wordcount_device_throughput", "input": "synthetic-zipf",
+    "h2d_gbps": 0.0194, "value": 0.4275, "unit": "GB/s", "devices": 1,
+    "backend": "tpu", "corpus_mb": 256.0, "streamed_ingest_gbps": 0.0088,
+}
+R5_CLOBBER = {
+    "metric": "zipf_wordcount_device_throughput", "input": "synthetic-zipf",
+    "h2d_gbps": 0.0496, "value": 0.4276, "unit": "GB/s", "devices": 1,
+    "backend": "tpu", "corpus_mb": 256.0, "streamed_ingest_gbps": 0.0028,
+}
+
+
+@pytest.fixture
+def last_good(tmp_path, monkeypatch):
+    """Redirect the record file and scrub ambient BENCH_* knobs so the
+    knob gate judges only what each test sets."""
+    path = tmp_path / "BENCH_LAST_GOOD.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+    for k in list(os.environ):
+        if k.startswith("BENCH_"):
+            monkeypatch.delenv(k)
+    return path
+
+
+def _read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_r5_clobber_replay_leaves_best_streamed_intact(last_good, capsys):
+    """THE regression this guard exists for: streamed 0.0088 -> 0.0028
+    under an equal 0.4276 headline must leave the 0.0088 record intact."""
+    bench._write_last_good(R5_GOOD)
+    bench._write_last_good(R5_CLOBBER)
+    rec = _read(last_good)
+    assert rec["best"]["streamed"]["value"] == 0.0088  # evidence intact
+    assert rec["best"]["headline"]["value"] == 0.4276  # better value kept
+    assert rec["best"]["h2d"]["value"] == 0.0496
+    assert rec["streamed_ingest_gbps"] == 0.0028  # last-run stays honest
+    err = capsys.readouterr().err
+    assert "refused" in err and "streamed" in err and "0.0088" in err
+
+
+def test_force_last_good_rebaselines_deliberately(last_good, monkeypatch):
+    bench._write_last_good(R5_GOOD)
+    monkeypatch.setenv("BENCH_FORCE_LAST_GOOD", "1")
+    bench._write_last_good(R5_CLOBBER)
+    rec = _read(last_good)
+    assert rec["best"]["streamed"]["value"] == 0.0028  # operator-owned
+
+
+def test_mild_regression_keeps_best_without_refusal(last_good, capsys):
+    """<=25% down is relay noise, not a regression: best keeps the max,
+    nothing is logged as refused."""
+    bench._write_last_good(R5_GOOD)
+    mild = {**R5_GOOD, "streamed_ingest_gbps": 0.0080}
+    bench._write_last_good(mild)
+    rec = _read(last_good)
+    assert rec["best"]["streamed"]["value"] == 0.0088
+    assert "refused" not in capsys.readouterr().err
+
+
+def test_legacy_record_seeds_best_ledger(last_good):
+    """A pre-round-6 (value-blind) file's evidence joins the per-metric
+    ledger instead of being silently discarded."""
+    legacy = {**R5_GOOD, "recorded_at": "2026-08-01T11:55:00Z"}
+    last_good.write_text(json.dumps(legacy))
+    bench._write_last_good(R5_CLOBBER)
+    rec = _read(last_good)
+    assert rec["best"]["streamed"]["value"] == 0.0088
+    assert rec["best"]["streamed"]["recorded_at"] == "2026-08-01T11:55:00Z"
+
+
+def test_ab_knob_write_refused_with_stderr_trace(last_good, capsys,
+                                                 monkeypatch):
+    """Measurement-altering BENCH_* knobs refuse the write — and say so on
+    stderr (ADVICE r5: a missing record update must be diagnosable)."""
+    monkeypatch.setenv("BENCH_SORT_IMPL", "radix_partition")
+    bench._write_last_good(R5_GOOD)
+    assert not last_good.exists()
+    err = capsys.readouterr().err
+    assert "refused" in err and "BENCH_SORT_IMPL" in err
+
+
+def test_probe_knobs_are_headline_safe(last_good, monkeypatch):
+    """BENCH_RETRY_BUDGET_S / BENCH_PROBE_TIMEOUT_S shape pre-measurement
+    reachability retries only (measurement-neutral, ADVICE r5): a run
+    under them must still record."""
+    monkeypatch.setenv("BENCH_RETRY_BUDGET_S", "900")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "60")
+    bench._write_last_good(R5_GOOD)
+    assert _read(last_good)["value"] == 0.4275
+
+
+def test_non_zipf_corpus_refused_with_stderr_trace(last_good, capsys):
+    bench._write_last_good({**R5_GOOD, "input": "synthetic-markup"})
+    assert not last_good.exists()
+    assert "refused" in capsys.readouterr().err
+
+
+# -- the link-normalized streamed metric (VERDICT r5 #3) ---------------------
+
+
+def test_streamed_ratio_on_checked_in_fixture():
+    """The r5 driver capture, from the checked-in BENCH_r05.json: the
+    tunnel-invariant form of its streamed row is 0.0028/0.0496."""
+    fixture = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_r05.json")
+    with open(fixture) as f:
+        parsed = json.load(f)["parsed"]
+    assert bench._streamed_ratio(parsed) == round(0.0028 / 0.0496, 4)
+
+
+def test_streamed_ratio_missing_or_zero_legs():
+    assert bench._streamed_ratio({}) is None
+    assert bench._streamed_ratio({"h2d_gbps": 0.02}) is None
+    assert bench._streamed_ratio({"streamed_ingest_gbps": 0.01}) is None
+    assert bench._streamed_ratio(
+        {"streamed_ingest_gbps": 0.01, "h2d_gbps": 0.0}) is None
+    assert bench._streamed_ratio(
+        {"streamed_ingest_gbps": 0.0088, "h2d_gbps": 0.0194}) == 0.4536

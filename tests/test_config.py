@@ -73,6 +73,10 @@ def test_defaults_match_measured_decisions():
     cfg = Config()
     assert cfg.chunk_bytes == 1 << 25  # 32 MB
     assert cfg.sort_mode == "stable2"  # round-5 on-chip A/B: +5.9% zipf
+    # Round-6 pricing note (BENCHMARKS.md): the radix partition loses 2-3x
+    # from measured rates — xla stays default until a live window says
+    # otherwise.
+    assert cfg.sort_impl == "xla"
     assert cfg.resolved_compact_slots == 128  # lane-major 384-byte windows
     assert cfg.resolved_block_rows == 384
     assert cfg.merge_every == 1
@@ -85,6 +89,7 @@ def test_defaults_match_measured_decisions():
     assert args.chunk_bytes == cfg.chunk_bytes
     assert args.merge_every == cfg.merge_every
     assert args.sort_mode == cfg.sort_mode
+    assert args.sort_impl == cfg.sort_impl
     assert args.compact_slots is None  # auto -> resolved_compact_slots
 
 

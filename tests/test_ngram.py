@@ -92,6 +92,8 @@ def test_unigram_order_matches_wordcount(fixture_text):
     assert uni.as_dict() == base.as_dict()
 
 
+@pytest.mark.slow  # 27 s measured round 6 (3 configs compiled): past the
+# tier-1 >=10 s line; gram totals stay covered by test_ngrams_match_oracle.
 def test_total_grams_is_tokens_minus_n_plus_1(small_corpus):
     tokens = oracle.total_count(small_corpus)
     for n in (1, 2, 3):
@@ -109,6 +111,49 @@ def test_fewer_tokens_than_n():
     r = wordcount.count_ngrams(b"only two", 3)
     assert r.total == 0
     assert r.words == []
+
+
+def test_gram_table_sentinel_boundary_at_max_pos_gate():
+    """The packed gram build's sentinel-collision envelope (ADVICE r5):
+    at the gate boundary max_pos == 2**25 a live row packs to
+    _SENT_PACKED only with pos == 2**25-1 AND len7 == 127 together —
+    unreachable, since a >=127-byte span cannot start within 127 bytes of
+    max_pos (gram_table documents the proof; this pins its premises).
+
+    Mechanically: rows AT the two extremes — the largest admissible pos
+    with a short span, and the latest-starting >=127-byte span — must
+    both survive the packed build with their identities intact."""
+    from mapreduce_tpu import constants
+    from mapreduce_tpu.ops import ngram as ngram_ops
+    from mapreduce_tpu.ops.tokenize import TokenStream
+
+    max_pos = 1 << 25
+    sent = np.uint32(0xFFFFFFFF)
+    n = 8
+    khi = np.full(n, sent, np.uint32)
+    klo = np.full(n, sent, np.uint32)
+    cnt = np.zeros(n, np.uint32)
+    pos = np.full(n, constants.POS_INF, np.uint32)
+    length = np.zeros(n, np.uint32)
+    # Row 0: a >=127-byte span at the latest start the invariant admits.
+    khi[0], klo[0], cnt[0] = 7, 11, 1
+    pos[0], length[0] = max_pos - 127, np.uint32(constants.SEAM_GRAM_LENGTH)
+    # Row 1: the largest admissible pos, 1-byte span (packed = 0xFFFFFF81).
+    khi[1], klo[1], cnt[1] = 13, 17, 1
+    pos[1], length[1] = max_pos - 1, 1
+    gs = TokenStream(key_hi=jnp.asarray(khi), key_lo=jnp.asarray(klo),
+                     count=jnp.asarray(cnt), pos=jnp.asarray(pos),
+                     length=jnp.asarray(length))
+    t = ngram_ops.gram_table(gs, 8, 0, max_pos=max_pos)
+    occ = np.asarray(t.occupied())
+    assert int(occ.sum()) == 2  # neither row collided with the sentinel
+    got = {(int(h), int(l)): (int(p), int(ln)) for h, l, p, ln in zip(
+        np.asarray(t.key_hi)[occ], np.asarray(t.key_lo)[occ],
+        np.asarray(t.pos_lo)[occ], np.asarray(t.length)[occ])}
+    assert got[(7, 11)] == (max_pos - 127,
+                            int(constants.SEAM_GRAM_LENGTH))
+    assert got[(13, 17)] == (max_pos - 1, 1)
+    assert int(np.asarray(t.dropped_count)) == 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,298 @@
+"""Config.sort_impl='radix*': the Pallas radix partition/sort vs XLA.
+
+Contract under test (ISSUE 3 acceptance): the radix path is BIT-IDENTICAL
+to the XLA sort path — stable tie order included — under interpret-mode
+oracle parity, for wordcount, top-k, and n-gram states; adversarial bucket
+skew falls back to the XLA sort exactly; config validation refuses the
+impossible combinations.
+
+Geometry and compile-budget notes (tier-1 runs on a one-core box):
+
+* An autouse fixture shrinks the kernel to bits=1 / block_rows=32 — kernel
+  jaxpr size, and so CPU compile cost, scales with B x log2(block_rows)
+  while the SEMANTICS are geometry-free.  At that geometry the slab cap
+  clamps to block_rows, so the partition branch is structurally spill-free
+  and every end-to-end test deterministically exercises the radix path
+  (never the fallback); the production geometry runs in the @slow tier.
+* The end-to-end tests share ONE module corpus and ONE Config so they
+  share one compiled program (the jit cache persists within a module).
+  Tests that change geometry beyond the autouse fixture must also change
+  a static Config field (capacity) — identical (shapes, config) under
+  different monkeypatched geometry would replay a stale program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.ops import table as table_ops
+from mapreduce_tpu.ops.pallas import radix as radix_ops
+from mapreduce_tpu.utils import oracle
+
+CAP = 4096
+
+
+def _cfg(sort_impl, **kw):
+    kw.setdefault("chunk_bytes", 128 * (2 * 32 + 2))
+    kw.setdefault("table_capacity", CAP)
+    return Config(backend="pallas", sort_impl=sort_impl, **kw)
+
+
+def _interpret():
+    from tests.conftest import pallas_interpret_mode
+
+    return pallas_interpret_mode()
+
+
+@pytest.fixture(autouse=True)
+def _small_radix_geometry(monkeypatch):
+    """Shrink the kernel for CPU-interpret compile budgets (module
+    docstring); bits/block_rows/slack are None-sentinel-resolved at call
+    time, so the module constants are the single override point."""
+    monkeypatch.setattr(radix_ops, "DEFAULT_BITS", 1)
+    monkeypatch.setattr(radix_ops, "DEFAULT_BLOCK_ROWS", 32)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """UNIFORM word draws (not the zipf fixture): hash-uniform keys keep
+    every per-(block, lane, bucket) occupancy far inside the slab budget,
+    so the partition branch runs for certain (no silent fallback making
+    the parity vacuous)."""
+    r = np.random.default_rng(7)
+    words = [f"w{i:x}" for i in range(150)]
+    return " ".join(words[int(i)]
+                    for i in r.integers(0, 150, size=3000)).encode()
+
+
+def _mixed_planes(rng, n=5000, vocab=60, dead_frac=0.3, poison_frac=0.01):
+    """A realistic packed stream: duplicate hashed keys (tie-order fodder),
+    position-ascending packed, dead filler, poison rows."""
+    keys = rng.integers(0, 0xFFFFFFF0, size=(vocab, 2), dtype=np.uint32)
+    idx = rng.integers(0, vocab, size=n)
+    khi = keys[idx, 0].copy()
+    klo = keys[idx, 1].copy()
+    pck = ((np.arange(n, dtype=np.uint64) << 6) | 5).astype(np.uint32)
+    dead = rng.random(n) < dead_frac
+    khi[dead] = 0xFFFFFFFF
+    klo[dead] = 0xFFFFFFFF
+    pck[dead] = 0xFFFFFFFF
+    pois = ~dead & (rng.random(n) < poison_frac)
+    khi[pois] = 0xFFFFFFFF
+    klo[pois] = 0xFFFFFFFE  # the reserved poison key (sent, sent-1)
+    pck[pois] = (np.arange(n, dtype=np.uint64)[pois] << 6).astype(np.uint32)
+    return tuple(jnp.asarray(x) for x in (khi, klo, pck))
+
+
+@pytest.mark.parametrize("impl", ["radix_partition", "radix"])
+def test_radix_sort3_bit_identical_to_lax_sort(rng, impl):
+    """The core contract at the sort seam: exact array equality with
+    jax.lax.sort(num_keys=3) — duplicate keys' tie order (by packed),
+    poison-segment order, and the trailing dead-filler segment included."""
+    khi, klo, pck = _mixed_planes(rng)
+    expect = jax.lax.sort((khi, klo, pck), num_keys=3)
+    with _interpret():
+        got = radix_ops.radix_sort3(khi, klo, pck, impl=impl, bits=2,
+                                    block_rows=32)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
+
+
+def test_radix_spill_counts_and_falls_back_exactly(rng):
+    """All-one-bucket skew: the partition slab overflows, the spill scalar
+    says so, and the lax.cond fallback reproduces the XLA sort exactly."""
+    n = 5000
+    khi = jnp.full((n,), jnp.uint32(0x12345678))
+    klo = jnp.full((n,), jnp.uint32(0x9ABCDEF0))
+    pck = jnp.asarray(((np.arange(n, dtype=np.uint64) << 6) | 3)
+                      .astype(np.uint32))
+    with _interpret():
+        # Direct kernel-level check: one hot bucket past an 8-row budget.
+        rows = jnp.asarray(np.full((64, 128), 0x12345678, np.uint32))
+        _, _, _, hist, spill = radix_ops._partition_level(
+            rows, rows, jnp.zeros_like(rows), shift=30, bits=2,
+            block_rows=64, cap=8, n_groups=1, interpret=True)
+        assert int(spill) > 0
+        assert int(np.asarray(hist).sum()) == 64 * 128  # counted, not lost
+        # End-to-end: same skew through radix_sort3 -> fallback, bit-exact.
+        got = radix_ops.radix_sort3(khi, klo, pck, impl="radix_partition",
+                                    bits=2, block_rows=32, slab_slack=1)
+    expect = jax.lax.sort((khi, klo, pck), num_keys=3)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
+
+
+def test_wordcount_radix_matches_oracle(corpus):
+    """End-to-end wordcount through the radix aggregation seam: words,
+    counts, insertion (first-occurrence) order, totals, and accounting all
+    match the host oracle — the tie-order contract made user-visible."""
+    with _interpret():
+        r = wordcount.count_words(corpus, _cfg("radix_partition"))
+    expected = oracle.word_counts(corpus)
+    assert list(r.as_dict()) == list(expected)  # insertion order included
+    assert r.as_dict() == expected
+    assert r.total == oracle.total_count(corpus)
+    assert r.dropped_count == 0
+
+
+def test_topk_radix_matches_oracle(corpus):
+    """top_k over a radix-built table: count-descending, ties by first
+    occurrence — checked against the host-derived expectation.  (Same
+    corpus + Config as the parity test: the device program is a cache
+    hit; only top_k is new work.)"""
+    with _interpret():
+        tbl = wordcount.count_table(corpus, _cfg("radix_partition"))
+        kt = table_ops.top_k(tbl, 16)
+    counts = np.asarray(kt.count).astype(np.int64) \
+        + (np.asarray(kt.count_hi).astype(np.int64) << 32)
+    pos = np.asarray(kt.pos_lo)
+    length = np.asarray(kt.length)
+    got = [(bytes(corpus[int(p): int(p) + int(ln)]), int(c))
+           for p, ln, c in zip(pos, length, counts) if c > 0]
+    counts_by_word = oracle.word_counts(corpus)
+    first_idx = {w: i for i, w in enumerate(counts_by_word)}
+    expected = sorted(counts_by_word.items(),
+                      key=lambda wc: (-wc[1], first_idx[wc[0]]))[:16]
+    assert got == expected
+    # Evicted mass is accounted: the table still explains every token.
+    assert int(np.asarray(kt.total_count())) == oracle.total_count(corpus)
+
+
+def test_ngram_radix_bit_identical_to_xla_impl(corpus):
+    """Bigram tables through the packed gram build: radix vs XLA sort
+    implementations must agree bit-for-bit (spans, counts, order)."""
+    with _interpret():
+        a = wordcount.count_ngrams(corpus, 2, _cfg("xla"))
+        b = wordcount.count_ngrams(corpus, 2, _cfg("radix_partition"))
+    assert a.words == b.words
+    assert a.counts == b.counts
+    assert a.total == b.total
+    assert a.dropped_count == b.dropped_count
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["sort3", "stable2"])
+def test_radix_serves_both_sort_modes(rng, mode):
+    """One radix implementation serves sort3 (its definition) and stable2
+    (ties by packed == tie order by position under the position-ordered
+    input precondition): from_packed_rows output tables must be identical
+    across (mode, impl) for a position-ordered packed stream."""
+    n = 4096
+    keys = rng.integers(0, 0xFFFFFFF0, size=(40, 2), dtype=np.uint32)
+    idx = rng.integers(0, 40, size=n)
+    khi = jnp.asarray(keys[idx, 0])
+    klo = jnp.asarray(keys[idx, 1])
+    pck = jnp.asarray(((np.arange(n, dtype=np.uint64) << 6) | 4)
+                      .astype(np.uint32))
+    total = jnp.uint32(n)
+    with _interpret():
+        base = table_ops.from_packed_rows(khi, klo, pck, total, 256, 0,
+                                          sort_mode=mode, sort_impl="xla")
+        radix = table_ops.from_packed_rows(khi, klo, pck, total, 256, 0,
+                                           sort_mode=mode,
+                                           sort_impl="radix_partition")
+    for a, b in zip(base, radix):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sort_impl_validation():
+    with pytest.raises(ValueError, match="sort_impl"):
+        Config(sort_impl="bogus")
+    with pytest.raises(ValueError, match="segmin"):
+        Config(sort_mode="segmin", sort_impl="radix")
+    with pytest.raises(ValueError, match="segmin"):
+        table_ops.from_packed_rows(
+            jnp.zeros((8,), jnp.uint32), jnp.zeros((8,), jnp.uint32),
+            jnp.full((8,), 0xFFFFFFFF, dtype=jnp.uint32), jnp.uint32(0),
+            4, 0, sort_mode="segmin", sort_impl="radix")
+    with pytest.raises(ValueError, match="sort_impl"):
+        table_ops.from_packed_rows(
+            jnp.zeros((8,), jnp.uint32), jnp.zeros((8,), jnp.uint32),
+            jnp.full((8,), 0xFFFFFFFF, dtype=jnp.uint32), jnp.uint32(0),
+            4, 0, sort_impl="bogus")
+    with pytest.raises(ValueError, match="impl"):
+        radix_ops.radix_sort3(jnp.zeros((8,), jnp.uint32),
+                              jnp.zeros((8,), jnp.uint32),
+                              jnp.zeros((8,), jnp.uint32), impl="bogus")
+    # The production default is pinned by the round-6 pricing note.
+    assert Config().sort_impl == "xla"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["radix_partition", "radix"])
+def test_radix_sort3_production_geometry(rng, impl):
+    """Sort-seam parity at the PRODUCTION kernel geometry (bits=3,
+    block_rows=256, slack 4) — the tier-1 params shrink it for compile
+    budget."""
+    khi, klo, pck = _mixed_planes(rng, n=20000)
+    expect = jax.lax.sort((khi, klo, pck), num_keys=3)
+    with _interpret():
+        got = radix_ops.radix_sort3(khi, klo, pck, impl=impl, bits=3,
+                                    block_rows=256, slab_slack=4)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
+
+
+@pytest.mark.slow
+def test_wordcount_radix_full_mode_matches_xla_impl(rng):
+    """The 2-level 'radix' mode end to end against the XLA impl (the
+    tier-1 e2e tests run radix_partition; the 2-level path's sort-seam
+    parity is in tier-1 above)."""
+    words = [f"w{i:x}" for i in range(200)]
+    corpus = " ".join(words[int(i)]
+                      for i in rng.integers(0, 200, size=4000)).encode()
+    with _interpret():
+        a = wordcount.count_words(corpus, _cfg("xla", table_capacity=2048))
+        b = wordcount.count_words(corpus,
+                                  _cfg("radix", table_capacity=2048))
+    assert a.words == b.words
+    assert a.counts == b.counts
+    assert a.total == b.total
+    assert a.dropped_count == b.dropped_count
+    assert a.as_dict() == oracle.word_counts(corpus)
+
+
+@pytest.mark.slow
+def test_wordcount_radix_hot_key_spills_into_exact_fallback(monkeypatch):
+    """A corpus that is ONE word repeated concentrates every live row in a
+    single digit bucket — the documented adversarial case for static
+    slabs.  With slack shrunk below the hot-key mass the spill cond must
+    take the fallback and still deliver exact counts.  (Fresh capacity:
+    same shapes under different monkeypatched geometry must not reuse a
+    cached program — module docstring.)"""
+    monkeypatch.setattr(radix_ops, "DEFAULT_SLAB_SLACK", 1)
+    corpus = b"aaa " * 1500
+    with _interpret():
+        r = wordcount.count_words(
+            corpus, _cfg("radix_partition", table_capacity=CAP // 2))
+    assert r.as_dict() == oracle.word_counts(corpus)
+    assert r.total == 1500
+
+
+@pytest.mark.slow
+def test_overlong_rescue_radix_matches_xla_impl():
+    """Overlong (>W) tokens — one crossing a lane seam — must be rescued
+    identically under the radix sort: poison rows keep position order in
+    the radix output (they sort by packed within the reserved-key
+    segment), so the rescue extraction sees the same slice."""
+    w = 32
+    n = 128 * (2 * w + 2)
+    seg = n // 128
+    buf = np.full(n, 0x20, dtype=np.uint8)
+    buf[seg - 20: seg + 20] = ord("u")  # crosses the first lane seam
+    buf[10:50] = ord("v")
+    words = b"aa bb cc aa "
+    buf[60:60 + len(words)] = np.frombuffer(words, dtype=np.uint8)
+    data = bytes(buf)
+    with _interpret():
+        a = wordcount.count_words(data, _cfg("xla", chunk_bytes=n))
+        b = wordcount.count_words(data,
+                                  _cfg("radix_partition", chunk_bytes=n))
+    assert a.words == b.words
+    assert a.counts == b.counts
+    assert a.total == b.total
+    assert a.dropped_count == b.dropped_count == 0
+    assert a.as_dict() == oracle.word_counts(data)

@@ -51,6 +51,7 @@ class TestRescueExact:
         assert rp.dropped_count == 0 and rp.dropped_uniques == 0
         assert rp.distinct == rx.distinct
 
+    @pytest.mark.slow  # 31 s measured round 6: past the tier-1 >=10 s line
     def test_repeated_overlong_word_accumulates(self, rng, oracle):
         url = b"http://example.com/a/very/long/path/segment/beyond-w"
         assert len(url) > 32
@@ -145,6 +146,7 @@ class TestRescueEnvelope:
         assert rp.words == rx.words
         assert rp.dropped_count == 0
 
+    @pytest.mark.slow  # 43 s measured round 6: past the tier-1 >=10 s line
     def test_tier_escalates_under_stable2_with_seam_poisons(self, rng):
         """The tiered path composes with stable2's split rescue sources
         (column poison segment + seam-stream poisons, re-sorted so the

@@ -11,6 +11,7 @@ oracle) on every corpus shape — tokens, counts, first occurrences,
 dropped accounting, overlong rescue, spill fallback, streamed runs.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -96,9 +97,14 @@ def test_stable2_bit_identical_to_sort3(rng, vocab, n_words):
     assert a.as_dict() == oracle.word_counts(corpus)
 
 
+@pytest.mark.slow
 def test_stable2_overlong_rescue_matches(rng):
     """Overlong tokens (> W) — including one crossing a lane seam — must be
-    rescued identically under both modes, with identical accounting."""
+    rescued identically under both modes, with identical accounting.
+
+    @slow (round 6): measured 55 s under the grown tier-1 suite — 5x past
+    the PR-1 ">= ~10 s carries slow" line; tier-1 keeps rescue covered via
+    test_rescue's boundary/envelope cases and production W=32 compiles."""
     w = 32  # production W here: the seam geometry below assumes min_chunk
     n = 128 * (2 * w + 2)
     seg = n // 128
@@ -155,6 +161,46 @@ def test_stable2_streamed_executor(tmp_path, rng):
                        mesh=data_mesh(8))
         b = count_file([str(p)], config=_cfg("stable2", chunk_bytes=1 << 14),
                        mesh=data_mesh(4))
+    _assert_results_equal(a, b)
+    assert a.as_dict() == oracle.word_counts(corpus)
+
+
+# The exact jax release whose pallas INTERPRET machinery deadlocks in
+# _allocate_buffer/_barrier under an 8-wide shard_map on a one-core box
+# (round-5 faulthandler dump) — the reason test_stable2_streamed_executor
+# runs stable2 on a 4-device mesh.  Pinned HERE so the workaround's
+# coverage gap cannot outlive its cause (ADVICE r5).
+_INTERPRET_DEADLOCK_JAX = "0.4.37"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    jax.__version__ == _INTERPRET_DEADLOCK_JAX,
+    reason="pinned to the jax pallas INTERPRET _allocate_buffer/_barrier "
+           "deadlock: stable2's lane-major kernel under an 8-wide "
+           "shard_map wedges interpret threads on this jax version "
+           "(round-5 faulthandler dump).  Any jax bump re-enables this "
+           "test automatically; if it then deadlocks again, re-pin "
+           "_INTERPRET_DEADLOCK_JAX to the new version and report "
+           "upstream.  @slow keeps a possible hang out of tier-1's "
+           "870 s budget either way.")
+def test_stable2_streamed_executor_8wide(tmp_path, rng):
+    """The coverage test_stable2_streamed_executor gives up to dodge the
+    interpret deadlock: streamed stable2 on the FULL 8-device mesh, vs
+    sort3 at the same width (on-chip Mosaic already streams this shape —
+    the bench streamed phase — so a pass here closes the last emulated
+    gap)."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+
+    corpus = make_corpus(rng, n_words=6000, vocab=150)
+    p = tmp_path / "c.txt"
+    p.write_bytes(corpus)
+    with _interpret():
+        a = count_file([str(p)], config=_cfg("sort3", chunk_bytes=1 << 14),
+                       mesh=data_mesh(8))
+        b = count_file([str(p)], config=_cfg("stable2", chunk_bytes=1 << 14),
+                       mesh=data_mesh(8))
     _assert_results_equal(a, b)
     assert a.as_dict() == oracle.word_counts(corpus)
 

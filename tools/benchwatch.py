@@ -119,6 +119,14 @@ def main() -> int:
                  {**ab, "BENCH_COMPACT_SLOTS": "0",
                   "BENCH_SORT_MODE": "sort3"}),
                 ("sortbench", [sys.executable, "tools/sortbench.py"], env),
+                # Round-6 radix A/B (BENCHMARKS.md pricing note predicts
+                # BOTH lose 2-3x to the XLA sort; these rows falsify or
+                # confirm that arithmetic on the chip — bit-identical
+                # results either way, spill falls back exactly).
+                ("bench-zipf-radixpart", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_SORT_IMPL": "radix_partition"}),
+                ("bench-zipf-radix", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_SORT_IMPL": "radix"}),
                 # Round-5 packed gram build vs the generic 7-array build
                 # (ops/ngram.py gram_table; +21% on CPU, expect more where
                 # the sort is the floor).
@@ -135,6 +143,11 @@ def main() -> int:
                  env),
                 ("opshare-sort3", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_SORT_MODE": "sort3"}),
+                # Re-profile under the radix partition: where the chunk
+                # budget moves when the XLA sort is replaced (partition
+                # kernel vs bucket sorts vs compaction shares).
+                ("opshare-radixpart", [sys.executable, "tools/opshare.py"],
+                 {**env, "OPSHARE_SORT_IMPL": "radix_partition"}),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}

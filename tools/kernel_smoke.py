@@ -65,6 +65,19 @@ def main() -> int:
         "stable2_lane_major": Config(backend="pallas", chunk_bytes=1 << 20,
                                      table_capacity=1 << 16,
                                      sort_mode="stable2"),
+        # The round-6 radix partition kernel (ops/pallas/radix.py): its
+        # Mosaic surface — SMEM (1, B) histogram blocks, 3*B+2 output
+        # refs, uint32 digit shifts — has never lowered on a real chip;
+        # smoking it here is what lets the benchwatch radix A/B rows
+        # spend a window on MEASUREMENT instead of discovering a
+        # lowering failure (the interpret suite validates semantics
+        # only).
+        "stable2_radix_partition": Config(backend="pallas",
+                                          chunk_bytes=1 << 20,
+                                          table_capacity=1 << 16,
+                                          sort_impl="radix_partition"),
+        "stable2_radix": Config(backend="pallas", chunk_bytes=1 << 20,
+                                table_capacity=1 << 16, sort_impl="radix"),
     }.items():
         try:
             r = wordcount.count_words(data, cfg)

@@ -14,6 +14,7 @@ Run on the chip:  python tools/opshare.py          (ambient backend)
 CPU sanity:       JAX_PLATFORMS=cpu python tools/opshare.py
 
 Env knobs: OPSHARE_CHUNK_MB (default 32), OPSHARE_SORT_MODE (sort3|segmin),
+OPSHARE_SORT_IMPL (xla|radix|radix_partition — the round-6 radix A/B),
 OPSHARE_MERGE_EVERY (default 1), OPSHARE_STEPS (steps profiled, default 4).
 Prints a final JSON line {"sort_share": ..., "top": [...]} for machines.
 """
@@ -84,13 +85,15 @@ def main() -> int:
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=sort_mode,
+                 sort_impl=os.environ.get("OPSHARE_SORT_IMPL",
+                                          Config.sort_impl),
                  merge_every=int(os.environ.get("OPSHARE_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["OPSHARE_COMPACT_SLOTS"])
                                 if "OPSHARE_COMPACT_SLOTS" in os.environ
                                 else None))
     print(f"backend={jax.default_backend()} chunk={chunk_mb}MB "
-          f"sort_mode={cfg.sort_mode} merge_every={cfg.merge_every} "
-          f"steps={steps}", file=sys.stderr)
+          f"sort_mode={cfg.sort_mode} sort_impl={cfg.sort_impl} "
+          f"merge_every={cfg.merge_every} steps={steps}", file=sys.stderr)
 
     rng = np.random.default_rng(3)
     data = rng.integers(97, 123, size=(1, cfg.chunk_bytes), dtype=np.uint8)
@@ -178,7 +181,8 @@ def main() -> int:
     print(json.dumps({
         "backend": jax.default_backend(),
         "chunk_mb": chunk_mb, "steps": steps,
-        "sort_mode": cfg.sort_mode, "merge_every": cfg.merge_every,
+        "sort_mode": cfg.sort_mode, "sort_impl": cfg.sort_impl,
+        "merge_every": cfg.merge_every,
         "compact_slots": cfg.compact_slots,
         "total_device_us": round(total, 0),
         # Per-chunk numbers are averaged over the device lines that carried

@@ -139,6 +139,31 @@ def main():
               a, b, c, n_tok_u, cap, 0, sort_mode="stable2"),
           (khi, klo, packed))
 
+    # Radix partition/sort rows (BENCHMARKS.md round-6 pricing note): the
+    # Pallas MSD digit partition behind Config.sort_impl, A/B'd against the
+    # raw and full-aggregation XLA sorts above.  Off-TPU the kernel runs in
+    # INTERPRET mode — orders of magnitude slower and meaningless to time —
+    # so the rows are chip-only unless SORTBENCH_RADIX=1 opts in (tiny
+    # SORTBENCH_LOG2 sanity runs).
+    if jax.default_backend() == "tpu" \
+            or os.environ.get("SORTBENCH_RADIX", "0") == "1":
+        from mapreduce_tpu.ops.pallas import radix as radix_ops
+
+        bench("radix_partition (1 level, B=8, + bucket sorts)",
+              lambda a, b, c: radix_ops.radix_sort3(
+                  a, b, c, impl="radix_partition"), (khi, klo, packed))
+        bench("radix (2 levels, B=8 each)",
+              lambda a, b, c: radix_ops.radix_sort3(a, b, c, impl="radix"),
+              (khi, klo, packed))
+        bench("from_packed_rows[stable2, radix_partition] full aggregation",
+              lambda a, b, c: table_ops.from_packed_rows(
+                  a, b, c, n_tok_u, cap, 0, sort_mode="stable2",
+                  sort_impl="radix_partition"),
+              (khi, klo, packed))
+    else:
+        print("radix rows skipped (interpret mode is not a measurement; "
+              "SORTBENCH_RADIX=1 opts in for sanity runs)")
+
     # The per-step pairwise table merge (the other half of a streaming step).
     t_a = table_ops.from_packed_rows(khi, klo, packed, n_tok_u, cap, 0)
     t_b = table_ops.from_packed_rows(klo, khi, packed, n_tok_u, cap, 1)
