@@ -16,7 +16,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="graphcheck",
         description="jaxpr-level static analyzer for mapreduce_tpu jobs "
                     "(reducer algebra, overflow/dtype, host-sync, "
-                    "sharding lints).")
+                    "sharding lints; costcheck: HBM cost, VMEM budget, "
+                    "kernel-race certification).")
     p.add_argument("models", nargs="*",
                    help="built-in model names to analyze "
                         "(default: all; see --list)")
@@ -35,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=8,
                    help="virtual device count for the analysis mesh "
                         "(forced-CPU; default 8)")
+    p.add_argument("--write-baselines", action="store_true",
+                   help="regenerate the per-model cost baselines "
+                        "(analysis/baselines/*.json) instead of gating "
+                        "against them — commit the result deliberately")
+    p.add_argument("--baselines-dir", default=None, metavar="DIR",
+                   help="read/write cost baselines here instead of the "
+                        "checked-in analysis/baselines/ (CI/test override)")
     return p
 
 
@@ -71,9 +79,20 @@ def main(argv=None) -> int:
             print(f"graphcheck: {e}", file=sys.stderr)
             return 2
         one = analysis.analyze_job(job, model=name, mesh=mesh,
-                                   corpus_bytes=args.corpus_bytes)
+                                   corpus_bytes=args.corpus_bytes,
+                                   baselines_dir=args.baselines_dir,
+                                   write_baselines=args.write_baselines)
         report.models.extend(one.models)
         report.extend(one.findings)
+        report.artifacts.update(one.artifacts)
+
+    # Shipped kernel geometries are certified once per run, not per model:
+    # the metadata hooks (ops/pallas/meta.py) cover production shapes the
+    # toy analysis configs never trace.
+    from mapreduce_tpu.analysis.passes.vmem import certify_production_kernels
+
+    report.models.append("<kernels>")
+    report.extend(certify_production_kernels())
 
     if args.json:
         print(report.as_json())

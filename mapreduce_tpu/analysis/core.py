@@ -81,10 +81,17 @@ class Finding:
 
 @dataclasses.dataclass
 class Report:
-    """All findings of one pipeline run (possibly over several models)."""
+    """All findings of one pipeline run (possibly over several models).
+
+    ``artifacts`` carries the machine-readable non-finding outputs passes
+    compute along the way (per-model cost reports, kernel VMEM footprints)
+    keyed ``{model: {artifact_name: jsonable}}`` — surfaced by
+    :meth:`as_json` so CI can consume the numbers, not just the verdicts.
+    """
 
     findings: list[Finding] = dataclasses.field(default_factory=list)
     models: list[str] = dataclasses.field(default_factory=list)
+    artifacts: dict = dataclasses.field(default_factory=dict)
 
     def extend(self, findings: Iterable[Finding]) -> None:
         self.findings.extend(findings)
@@ -132,6 +139,7 @@ class Report:
         return json.dumps({
             "models": self.models,
             "findings": [f.as_dict() for f in self.sorted_findings()],
+            "artifacts": self.artifacts,
             "exit_code": self.exit_code,
         }, indent=2)
 
@@ -181,7 +189,9 @@ class AnalysisContext:
     def __init__(self, job: Any, model: str, mesh=None, *,
                  corpus_bytes: int = 1 << 40,
                  property_chunk_bytes: int = 1 << 10,
-                 property_samples: int = 3):
+                 property_samples: int = 3,
+                 baselines_dir: Optional[str] = None,
+                 write_baselines: bool = False):
         from mapreduce_tpu.parallel.mesh import data_mesh
 
         self.job = job
@@ -190,8 +200,12 @@ class AnalysisContext:
         self.corpus_bytes = int(corpus_bytes)
         self.property_chunk_bytes = int(property_chunk_bytes)
         self.property_samples = int(property_samples)
+        self.baselines_dir = baselines_dir  # None -> the checked-in dir
+        self.write_baselines = bool(write_baselines)
+        self.artifacts: dict = {}  # pass outputs, copied into the Report
         self._hook_traces = None
         self._engine_traces = None
+        self._pallas_calls = None
         self._property_states = None
         self.property_failure = None  # TraceFailure when sampling failed
 
@@ -222,6 +236,18 @@ class AnalysisContext:
 
             self._engine_traces = trace.trace_engine(self.job, self.mesh)
         return self._engine_traces
+
+    @property
+    def pallas_calls(self):
+        """``(infos, undigestable)`` — every pallas_call binding reachable
+        from the engine step/finish programs, digested once for the
+        vmem/kernel-race passes (:mod:`..pallas_info`)."""
+        if self._pallas_calls is None:
+            from mapreduce_tpu.analysis import pallas_info
+
+            self._pallas_calls = pallas_info.collect_pallas_calls(
+                self.engine_traces)
+        return self._pallas_calls
 
     @property
     def state_shape(self):
@@ -262,6 +288,8 @@ def run_pipeline(ctx: AnalysisContext,
                 hook="<pipeline>",
                 message=f"pass crashed: {type(e).__name__}: {e}",
                 hint="fix the pass (or report a graphcheck bug)"))
+    if ctx.artifacts:
+        report.artifacts[ctx.model] = ctx.artifacts
     return report
 
 

@@ -1,0 +1,331 @@
+"""Pallas kernel bindings extracted from traced jaxprs.
+
+The vmem and kernel-race passes both need the same view of every
+``pallas_call`` equation reachable from a traced program: which kernel it
+is, its grid, every ref's block shape / dtype / memory space, which grid
+iterations revisit the same block (the index map evaluated over the whole
+grid), the scratch shapes, and the kernel body jaxpr itself.  This module
+builds that view once (:func:`collect_pallas_calls`) so the passes stay
+pure policy.
+
+Everything here reads public-enough jax internals (``GridMapping`` /
+``BlockMapping`` from ``jax._src.pallas.core``) *defensively*: a missing
+attribute degrades to ``None``/unknown and the passes downgrade their
+findings accordingly, rather than crashing the pipeline on a jax bump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from mapreduce_tpu.analysis import trace
+
+# Revisit detection enumerates the grid; anything larger is reported as
+# unverified rather than stalling analysis (production grids reach ~10^3,
+# analysis-config grids are single digits).
+MAX_GRID_ENUM = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class RefInfo:
+    """One kernel operand ref: an input/output block or a scratch buffer."""
+
+    role: str  # "in" | "out" | "scratch"
+    index: int  # position within its role
+    block_shape: tuple  # block shape (scratch: full shape)
+    dtype: Any  # numpy dtype of the buffer
+    memory_space: str  # "vmem" | "smem" | "any" | "?"
+    array_shape: Optional[tuple]  # full HBM-side array shape (None: scratch)
+    revisited: Optional[bool]  # same block touched by >1 grid iteration
+    # (None = could not be determined: dynamic grid, enum bound exceeded)
+
+    @property
+    def block_bytes(self) -> int:
+        return int(math.prod(self.block_shape) * self.dtype.itemsize)
+
+    @property
+    def array_bytes(self) -> int:
+        if self.array_shape is None:
+            return 0
+        return int(math.prod(self.array_shape) * self.dtype.itemsize)
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasCallInfo:
+    """One pallas_call equation, digested for the analysis passes."""
+
+    kernel_name: str  # e.g. "_tokenize_kernel"
+    src: str  # "name at file:line" (from name_and_src_info)
+    program: str  # which traced program it was found in (step/finish/...)
+    grid: tuple
+    refs: tuple  # RefInfo, kernel-argument order: ins, outs, scratch
+    kernel_jaxpr: Any  # the kernel body Jaxpr (refs are its invars)
+    vmem_limit_bytes: Optional[int]  # mosaic compiler-params override
+    dimension_semantics: Any  # mosaic grid-parallelism declaration
+    enclosing_has_cond: bool  # a cond primitive exists in the same program
+
+    @property
+    def ins(self) -> tuple:
+        return tuple(r for r in self.refs if r.role == "in")
+
+    @property
+    def outs(self) -> tuple:
+        return tuple(r for r in self.refs if r.role == "out")
+
+    @property
+    def scratch(self) -> tuple:
+        return tuple(r for r in self.refs if r.role == "scratch")
+
+    def signature(self) -> tuple:
+        """Dedup key: the same kernel binding traced into several branches
+        (spill-fallback conds) should be certified once."""
+        return (self.kernel_name, self.grid,
+                tuple((r.role, r.block_shape, str(r.dtype), r.memory_space)
+                      for r in self.refs))
+
+
+def _memory_space_of(aval) -> str:
+    ms = getattr(aval, "memory_space", None)
+    if ms is None:
+        return "?"
+    s = str(ms).lower()
+    for known in ("vmem", "smem", "sem", "any"):
+        if known in s:
+            return known
+    return s or "?"
+
+
+def _eval_index_map(bm, idx: tuple) -> Optional[tuple]:
+    imj = getattr(bm, "index_map_jaxpr", None)
+    if imj is None:
+        return None
+    try:
+        out = jax.core.eval_jaxpr(imj.jaxpr, imj.consts, *idx)
+        return tuple(int(x) for x in out)
+    except Exception:
+        return None
+
+
+def _revisited(bm, grid: tuple) -> Optional[bool]:
+    """Does any block index recur across grid iterations?  None: unknown."""
+    try:
+        points = int(math.prod(grid)) if grid else 1
+    except TypeError:  # dynamic grid bound
+        return None
+    if points > MAX_GRID_ENUM:
+        return None
+    seen = set()
+    # Row-major enumeration of the grid index space.
+    dims = [int(g) for g in grid] or [1]
+    idx = [0] * len(dims)
+    for _ in range(points):
+        block = _eval_index_map(bm, tuple(idx))
+        if block is None:
+            return None
+        if block in seen:
+            return True
+        seen.add(block)
+        for d in reversed(range(len(dims))):
+            idx[d] += 1
+            if idx[d] < dims[d]:
+                break
+            idx[d] = 0
+    return False
+
+
+def _kernel_invars(kernel_jaxpr) -> list:
+    j = getattr(kernel_jaxpr, "jaxpr", kernel_jaxpr)
+    return list(j.invars)
+
+
+def digest_eqn(eqn, program: str, enclosing_has_cond: bool
+               ) -> Optional[PallasCallInfo]:
+    """Build a PallasCallInfo from one pallas_call equation (None when the
+    params cannot be read — the caller reports that as an INFO finding)."""
+    params = eqn.params
+    gm = params.get("grid_mapping")
+    kj = params.get("jaxpr")
+    if gm is None or kj is None:
+        return None
+    name_info = str(params.get("name_and_src_info", "") or "")
+    kernel_name = name_info.split(" at ")[0].strip() or "<pallas-kernel>"
+    grid = tuple(getattr(gm, "grid", ()) or ())
+
+    n_in = int(getattr(gm, "num_inputs", 0))
+    mappings = list(getattr(gm, "block_mappings", ()) or ())
+    refs: list[RefInfo] = []
+    for i, bm in enumerate(mappings):
+        role = "in" if i < n_in else "out"
+        aval = getattr(bm, "block_aval", None)
+        inner = getattr(aval, "inner_aval", aval)
+        shape = tuple(getattr(bm, "block_shape", ()) or
+                      getattr(inner, "shape", ()))
+        dtype = np.dtype(getattr(inner, "dtype", np.uint8))
+        full = getattr(bm, "array_shape_dtype", None)
+        refs.append(RefInfo(
+            role=role, index=i if role == "in" else i - n_in,
+            block_shape=shape, dtype=dtype,
+            memory_space=_memory_space_of(aval),
+            array_shape=tuple(full.shape) if full is not None else None,
+            revisited=_revisited(bm, grid)))
+    invars = _kernel_invars(kj)
+    # Kernel invars trail with the scratch operands.
+    n_scratch = int(getattr(gm, "num_scratch_operands", 0))
+    for s, v in enumerate(invars[len(invars) - n_scratch:] if n_scratch
+                          else []):
+        aval = v.aval
+        inner = getattr(aval, "inner_aval", aval)
+        refs.append(RefInfo(
+            role="scratch", index=s,
+            block_shape=tuple(getattr(inner, "shape", ())),
+            dtype=np.dtype(getattr(inner, "dtype", np.uint8)),
+            memory_space=_memory_space_of(aval),
+            array_shape=None,
+            revisited=True))  # scratch persists across grid iterations
+
+    cp = params.get("compiler_params") or {}
+    mosaic = cp.get("mosaic", {}) if isinstance(cp, dict) else {}
+    if not isinstance(mosaic, dict):  # newer jax: a params dataclass
+        mosaic = {k: getattr(mosaic, k, None)
+                  for k in ("vmem_limit_bytes", "dimension_semantics")}
+    return PallasCallInfo(
+        kernel_name=kernel_name, src=name_info, program=program,
+        grid=grid, refs=tuple(refs), kernel_jaxpr=kj,
+        vmem_limit_bytes=mosaic.get("vmem_limit_bytes"),
+        dimension_semantics=mosaic.get("dimension_semantics"),
+        enclosing_has_cond=enclosing_has_cond)
+
+
+def _has_cond_outside_kernels(jaxpr) -> bool:
+    """A ``cond`` primitive reachable WITHOUT descending into pallas_call
+    kernel bodies: the spill-fallback reachability signal (a ``pl.when``
+    inside the kernel itself guards nothing about the spill result)."""
+    j = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in j.eqns:
+        if eqn.primitive.name == "cond":
+            return True
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for sub in trace.eqn_subjaxprs(eqn):
+            if _has_cond_outside_kernels(sub):
+                return True
+    return False
+
+
+def collect_pallas_calls(traces: dict) -> tuple[list, list]:
+    """Digest every pallas_call reachable from ``{program: ClosedJaxpr |
+    TraceFailure}``.  Returns ``(infos, undigestable)`` where undigestable
+    is ``[(program, src_string)]`` for equations whose params could not be
+    read (jax drift) — the passes surface those instead of silently
+    certifying nothing."""
+    infos: list[PallasCallInfo] = []
+    bad: list[tuple[str, str]] = []
+    seen: set = set()
+    for program, traced in traces.items():
+        if isinstance(traced, trace.TraceFailure):
+            continue
+        has_cond = _has_cond_outside_kernels(traced)
+        for eqn, _ in trace.iter_eqns(traced):
+            if eqn.primitive.name != "pallas_call":
+                continue
+            info = digest_eqn(eqn, program, has_cond)
+            if info is None:
+                bad.append((program,
+                            str(eqn.params.get("name_and_src_info", "?"))))
+                continue
+            sig = info.signature()
+            if sig in seen:
+                continue
+            seen.add(sig)
+            infos.append(info)
+    return infos, bad
+
+
+# -- kernel-body ref event analysis (for the race lint) ----------------------
+
+# Ref-access primitives in pallas kernel jaxprs: `ref[...]` reads lower to
+# `get`, `ref[...] = x` to `swap` (result unused), accumulation to
+# `addupdate` (an atomic read-modify-write).
+_READS = {"get", "masked_load"}
+_WRITES = {"swap", "masked_swap"}
+_RMW = {"addupdate"}
+
+
+@dataclasses.dataclass(frozen=True)
+class RefEvent:
+    kind: str  # "read" | "write"
+    guarded: bool  # inside a cond branch (pl.when / lax.cond)
+    order: int  # program-order index within the kernel body
+
+
+def ref_events(kernel_jaxpr) -> dict[int, list[RefEvent]]:
+    """Per-ref read/write events of a kernel body, in program order.
+
+    Returns ``{kernel_invar_position: [RefEvent, ...]}``.  Conditional
+    scopes (``pl.when`` lowers to ``cond``) mark their events guarded;
+    refs closed over into branch/body jaxprs are followed through the
+    equation's invars (branch invars map 1:1 onto ``eqn.invars[1:]`` for
+    cond, onto ``eqn.invars`` for pjit-style calls).
+    """
+    j = getattr(kernel_jaxpr, "jaxpr", kernel_jaxpr)
+    root_refs = {v: i for i, v in enumerate(j.invars)}
+    events: dict[int, list[RefEvent]] = {}
+    counter = [0]
+
+    def record(pos: int, kind: str, guarded: bool) -> None:
+        events.setdefault(pos, []).append(
+            RefEvent(kind=kind, guarded=guarded, order=counter[0]))
+
+    def lookup(refmap: dict, v) -> Optional[int]:
+        # Equation operands may be unhashable Literals, never refs.
+        try:
+            return refmap.get(v)
+        except TypeError:
+            return None
+
+    def walk(jaxpr, refmap: dict, guarded: bool) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            counter[0] += 1
+            if name in _READS or name in _WRITES or name in _RMW:
+                pos = lookup(refmap, eqn.invars[0])
+                if pos is not None:
+                    if name in _READS or name in _RMW:
+                        record(pos, "read", guarded)
+                    if name in _WRITES or name in _RMW:
+                        record(pos, "write", guarded)
+                continue
+            subs = trace.eqn_subjaxprs(eqn)
+            if not subs:
+                continue
+            sub_guarded = guarded or name == "cond"
+            # Map refs that flow into the sub-jaxpr: cond passes operands
+            # [pred, *args] with branch invars = args; call-like primitives
+            # (pjit, scan, while) pass operands 1:1 (scan/while carry
+            # prefixes don't matter here — only ref-typed vars can match).
+            operands = eqn.invars[1:] if name == "cond" else eqn.invars
+            for sub in subs:
+                sj = getattr(sub, "jaxpr", sub)
+                submap: dict = {}
+                for outer, inner in zip(operands, sj.invars):
+                    pos = lookup(refmap, outer)
+                    if pos is not None:
+                        submap[inner] = pos
+                if len(sj.invars) != len(operands) and not submap:
+                    # Arity mismatch (consts prefix, carry layout): retry
+                    # aligning from the tail, where pallas puts refs.
+                    for outer, inner in zip(reversed(operands),
+                                            reversed(sj.invars)):
+                        pos = lookup(refmap, outer)
+                        if pos is not None:
+                            submap[inner] = pos
+                if submap:
+                    walk(sj, submap, sub_guarded)
+
+    walk(j, dict(root_refs), False)
+    return events

@@ -1,6 +1,7 @@
 """Built-in graphcheck passes.  Import order = pipeline run order."""
 
 from mapreduce_tpu.analysis.passes import (algebra, overflow, hostsync,
-                                           sharding)
+                                           sharding, cost, vmem, kernelrace)
 
-__all__ = ["algebra", "overflow", "hostsync", "sharding"]
+__all__ = ["algebra", "overflow", "hostsync", "sharding", "cost", "vmem",
+           "kernelrace"]

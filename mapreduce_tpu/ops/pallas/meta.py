@@ -1,0 +1,123 @@
+"""Kernel metadata for the static analyzer (costcheck's vmem/race passes).
+
+Each Pallas kernel module declares, next to the kernel it describes:
+
+* a :class:`KernelMeta` entry — spill semantics (does the kernel emit a
+  spill counter whose nonzero value REQUIRES an exactness fallback in the
+  caller?) keyed by the kernel function's name, which is how a traced
+  ``pallas_call`` equation identifies itself (``name_and_src_info``);
+* a ``vmem_plan`` hook returning :class:`VmemPlan` — the kernel's
+  VMEM/SMEM footprint at a given geometry, computed from the same
+  BlockSpec/scratch arithmetic the wrapper uses, so the analyzer can
+  certify PRODUCTION geometries without tracing a production-sized
+  program (analysis-config traces certify the same kernels at toy grids).
+
+The per-core budgets live here too, single-owner: Mosaic's default VMEM
+stack budget is 16 MB (measured: the compact tokenize kernel exceeds it
+and ships a 64 MB override — ops/pallas/tokenize.py); v5e carries ~128 MB
+physical VMEM, the hard ceiling no override may cross.  SMEM holds only
+scalars/control (pallas guide); the shipped kernels use tens of bytes —
+the 64 KiB budget is generous headroom, not a measured limit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+VMEM_DEFAULT_LIMIT = 16 * 1024 * 1024  # Mosaic default stack budget
+VMEM_PHYSICAL = 128 * 1024 * 1024  # v5e per-core physical VMEM
+SMEM_BUDGET = 64 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelMeta:
+    """Analyzer-facing contract of one Pallas kernel function."""
+
+    name: str  # kernel function __name__ (pallas_call's own id)
+    # Does this binding emit a spill counter requiring a caller-side
+    # exactness fallback?  Receives (num_outputs,) — the tokenize kernel
+    # only spills in compact mode (6 outputs vs the pair path's 5).
+    spills: Callable[[int], bool]
+    description: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One VMEM/SMEM allocation of a kernel binding."""
+
+    label: str
+    space: str  # "vmem" | "smem"
+    bytes: int
+    double_buffered: bool  # pipelined in/out blocks get 2x
+
+
+@dataclasses.dataclass(frozen=True)
+class VmemPlan:
+    """Static footprint of one kernel geometry.
+
+    ``vmem_bytes`` counts pipelined blocks twice (Pallas double-buffers
+    grid in/out blocks so the next block's DMA overlaps compute) plus
+    scratch once.  It is a LOWER bound: Mosaic may spill intermediate
+    vectors to VMEM beyond declared blocks — which is exactly why the
+    compact kernels ship an explicit ``vmem_limit_bytes`` override and the
+    analyzer checks the plan against that declared limit, not against the
+    physical ceiling alone.
+    """
+
+    kernel: str
+    geometry: str  # human description of the knob setting
+    buffers: tuple  # Buffer
+    vmem_limit_bytes: Optional[int] = None  # kernel's own compiler override
+
+    @property
+    def vmem_bytes(self) -> int:
+        return sum(b.bytes * (2 if b.double_buffered else 1)
+                   for b in self.buffers if b.space == "vmem")
+
+    @property
+    def smem_bytes(self) -> int:
+        return sum(b.bytes * (2 if b.double_buffered else 1)
+                   for b in self.buffers if b.space == "smem")
+
+    @property
+    def budget(self) -> int:
+        return self.vmem_limit_bytes or VMEM_DEFAULT_LIMIT
+
+    def as_dict(self) -> dict:
+        return {"kernel": self.kernel, "geometry": self.geometry,
+                "vmem_bytes": self.vmem_bytes,
+                "smem_bytes": self.smem_bytes,
+                "vmem_limit_bytes": self.vmem_limit_bytes,
+                "budget_bytes": self.budget,
+                "buffers": [dataclasses.asdict(b) for b in self.buffers]}
+
+
+_KERNEL_META: dict[str, KernelMeta] = {}
+
+
+def register(meta: KernelMeta) -> KernelMeta:
+    """Add (or replace — test idiom) a kernel's analyzer metadata."""
+    _KERNEL_META[meta.name] = meta
+    return meta
+
+
+def lookup(kernel_name: str) -> Optional[KernelMeta]:
+    return _KERNEL_META.get(kernel_name)
+
+
+def production_plans() -> list[VmemPlan]:
+    """Every SHIPPED kernel geometry's static footprint: the stable2
+    default, the sort3 compact and pair variants, and both radix levels'
+    partition kernel — the set the vmem pass certifies regardless of which
+    analysis-config models happened to trace them."""
+    from mapreduce_tpu.ops.pallas import radix, tokenize
+
+    return [
+        tokenize.vmem_plan(block_rows=384, compact_slots=128,
+                           lane_major=True),   # stable2 default
+        tokenize.vmem_plan(block_rows=256, compact_slots=88),  # sort3 compact
+        tokenize.vmem_plan(block_rows=256, compact_slots=0),   # pair path
+        radix.vmem_plan(),                                     # default B=8
+        radix.vmem_plan(bits=5),                               # widest legal B
+    ]

@@ -65,6 +65,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from mapreduce_tpu import constants
+from mapreduce_tpu.ops.pallas import meta
 from mapreduce_tpu.ops.pallas.tokenize import LANES, _compact_planes
 
 DEFAULT_BITS = 3  # B = 8 buckets per level
@@ -76,6 +77,37 @@ DEFAULT_BLOCK_ROWS = 256
 DEFAULT_SLAB_SLACK = 4
 
 _IMPLS = ("radix_partition", "radix")
+
+# Analyzer contract (costcheck vmem/race passes): the partition kernel
+# ALWAYS emits a spill counter — live rows beyond a lane's slab budget
+# mean the slabs are incomplete and radix_sort3's lax.cond MUST fall back
+# to the exact XLA sort.
+meta.register(meta.KernelMeta(
+    name="_partition_kernel",
+    spills=lambda num_outputs: True,
+    description="MSD digit partition into static slabs; adversarial "
+                "bucket skew spills past the slab budget"))
+
+
+def vmem_plan(bits: int = DEFAULT_BITS,
+              block_rows: int = DEFAULT_BLOCK_ROWS,
+              slab_slack: int = DEFAULT_SLAB_SLACK) -> meta.VmemPlan:
+    """Static VMEM/SMEM footprint of one partition-kernel geometry, from
+    the same BlockSpec arithmetic :func:`_partition_level` binds — the
+    analyzer's metadata hook (ops/pallas/meta.py)."""
+    B = 1 << bits
+    cap = min(slab_slack * block_rows // B, block_rows)
+    bufs = [meta.Buffer(f"plane-in[{i}]", "vmem", block_rows * LANES * 4,
+                        True) for i in range(3)]
+    bufs += [meta.Buffer(f"slab-out[{b}]", "vmem", cap * LANES * 4, True)
+             for b in range(3 * B)]
+    bufs.append(meta.Buffer("histogram", "smem", B * 4, False))
+    bufs.append(meta.Buffer("spill", "smem", 4, False))
+    return meta.VmemPlan(
+        kernel="_partition_kernel",
+        geometry=f"bits={bits} block_rows={block_rows} "
+                 f"slab_slack={slab_slack} (cap={cap})",
+        buffers=tuple(bufs))
 
 
 def _partition_kernel(khi_ref, klo_ref, pck_ref, *out_refs, shift: int,

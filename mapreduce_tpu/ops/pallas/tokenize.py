@@ -51,11 +51,44 @@ from jax.experimental.pallas import tpu as pltpu
 
 from mapreduce_tpu import constants
 from mapreduce_tpu.ops import tokenize as tok_ops
+from mapreduce_tpu.ops.pallas import meta
 from mapreduce_tpu.ops.tokenize import TokenStream
 
 LANES = 128
 DEFAULT_MAX_TOKEN = 32  # W: max token bytes handled fully on the fast path
 DEFAULT_BLOCK_ROWS = 256
+
+# Analyzer contract (costcheck vmem/race passes): compact mode emits a
+# spill counter (output #6) whose nonzero value means the planes are
+# INCOMPLETE — the caller MUST wrap a full-resolution fallback in lax.cond
+# (models/wordcount._map_stream does).  The pair path (5 outputs) is exact.
+meta.register(meta.KernelMeta(
+    name="_tokenize_kernel",
+    spills=lambda num_outputs: num_outputs >= 6,
+    description="fused tokenize+hash; compact mode spills past the "
+                "per-window slot budget"))
+
+
+def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
+              compact_slots: int = 0, w: int = DEFAULT_MAX_TOKEN,
+              lane_major: bool = False) -> meta.VmemPlan:
+    """Static VMEM/SMEM footprint of one tokenize-kernel geometry, from
+    the same BlockSpec/scratch arithmetic :func:`_column_pass` binds —
+    the analyzer's metadata hook (ops/pallas/meta.py)."""
+    out_rows = compact_slots if compact_slots else block_rows // 2
+    n_scalars = 3 if compact_slots else 2
+    bufs = [meta.Buffer("bytes-in", "vmem", block_rows * LANES, True)]
+    bufs += [meta.Buffer(f"plane-out[{i}]", "vmem", out_rows * LANES * 4,
+                         True) for i in range(3)]
+    bufs += [meta.Buffer(f"scalar[{i}]", "smem", 4, False)
+             for i in range(n_scalars)]
+    bufs.append(meta.Buffer("carry-scratch", "vmem", (w + 1) * LANES * 4,
+                            False))
+    geom = (f"block_rows={block_rows} w={w} slots={compact_slots or 'pair'}"
+            + (" lane-major" if lane_major else ""))
+    return meta.VmemPlan(
+        kernel="_tokenize_kernel", geometry=geom, buffers=tuple(bufs),
+        vmem_limit_bytes=64 * 1024 * 1024 if compact_slots else None)
 
 
 class PackedTokenStream(NamedTuple):

@@ -33,6 +33,7 @@ def _run(args, cwd=REPO):
     )
 
 
+@pytest.mark.smoke
 def test_reference_stdout_parity(tmp_path):
     fixture = tmp_path / "test.txt"
     fixture.write_text("Hello World EveryOne\nWorld Good News\nGood Morning Hello\n")
@@ -49,6 +50,7 @@ def test_default_filename_is_test_txt(tmp_path):
     assert "a\t2" in r.stdout and "Total Count:3" in r.stdout
 
 
+@pytest.mark.smoke
 def test_missing_file_is_an_error(tmp_path):
     """The reference silently prints an empty result on fopen failure
     (main.cu:174); we surface the failure (SURVEY §5 failure detection)."""
@@ -57,6 +59,7 @@ def test_missing_file_is_an_error(tmp_path):
     assert "cannot read" in r.stderr
 
 
+@pytest.mark.smoke
 def test_json_format(tmp_path):
     f = tmp_path / "in.txt"
     f.write_text("x y x z\n")
@@ -85,6 +88,7 @@ def test_bad_chunk_bytes_is_clean_error(tmp_path):
     assert "chunk_bytes" in r.stderr and "Traceback" not in r.stderr
 
 
+@pytest.mark.smoke
 def test_top_k(tmp_path):
     f = tmp_path / "in.txt"
     f.write_text("a a a b b c\n")

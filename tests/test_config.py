@@ -13,6 +13,9 @@ import pytest
 
 from mapreduce_tpu.config import Config
 
+# Pure-host validation logic: the cheapest module in the fast tier.
+pytestmark = pytest.mark.smoke
+
 
 def test_default_backend_is_auto():
     assert Config().backend == "auto"

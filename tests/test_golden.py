@@ -5,9 +5,14 @@ Morning 1; Total Count 9 — in first-occurrence order, matching the reference
 report loop (main.cu:212-218).
 """
 
+import pytest
+
 from mapreduce_tpu.config import SMALL_CONFIG
 from mapreduce_tpu.models import wordcount
 from mapreduce_tpu.utils import oracle
+
+# The whole golden module rides in the fast iteration tier (tools/smoke.sh).
+pytestmark = pytest.mark.smoke
 
 GOLDEN = [(b"Hello", 2), (b"World", 2), (b"EveryOne", 1), (b"Good", 2), (b"News", 1), (b"Morning", 1)]
 

@@ -220,7 +220,10 @@ def test_cli_json_shape(capsys):
     rc = main(["grep", "--json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == payload["exit_code"] == 0
-    assert payload["models"] == ["grep"]
+    # The CLI certifies shipped kernel geometries once per run, appended
+    # as the pseudo-model <kernels>.
+    assert payload["models"] == ["grep", "<kernels>"]
+    assert "artifacts" in payload
     for f in payload["findings"]:
         assert {"severity", "pass_id", "model", "hook", "message",
                 "location", "hint"} <= set(f)

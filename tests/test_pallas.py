@@ -43,6 +43,7 @@ def _assert_tables_equal(want, got):
             np.asarray(getattr(want, f)), np.asarray(getattr(got, f)), err_msg=f)
 
 
+@pytest.mark.smoke
 def test_fixture_exact(fixture_text):
     want, got, overlong = _tables(fixture_text)
     assert overlong == 0

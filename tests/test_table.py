@@ -25,6 +25,7 @@ def _to_dict(t: tbl.CountTable):
     return {(int(h), int(l)): int(n) for h, l, n in zip(hi, lo, c) if n > 0}
 
 
+@pytest.mark.smoke
 def test_empty_table():
     t = tbl.empty(16)
     assert int(t.n_valid()) == 0
@@ -72,6 +73,7 @@ def test_merge_associative_commutative(rng):
     assert _to_dict(ab_c) == _to_dict(a_bc) == _to_dict(c_ba)
 
 
+@pytest.mark.smoke
 def test_merge_with_empty_is_identity(small_corpus):
     t = tbl.from_stream(_stream(small_corpus), 512)
     m = tbl.merge(t, tbl.empty(512), 512)
@@ -80,6 +82,7 @@ def test_merge_with_empty_is_identity(small_corpus):
            np.asarray(t.pos_lo)[: int(t.n_valid())].tolist()
 
 
+@pytest.mark.smoke
 def test_overflow_accounting():
     """Past capacity: counts spill into dropped_*, never corrupt (cf. main.cu:103-104)."""
     data = " ".join(f"u{i}" for i in range(100)).encode()
@@ -134,6 +137,7 @@ def test_update_streaming_equals_batch(rng):
     assert _to_dict(t) == _to_dict(whole)
 
 
+@pytest.mark.smoke
 def test_top_k(small_corpus):
     t = tbl.from_stream(_stream(small_corpus), 1024)
     k = tbl.top_k(t, 5)
@@ -152,6 +156,7 @@ def test_top_k_preserves_totals(small_corpus):
     assert int(k.dropped_uniques) == n_distinct - 5
 
 
+@pytest.mark.smoke
 def test_counts_dtype_uint32(small_corpus):
     t = tbl.from_stream(_stream(small_corpus), 256)
     assert t.count.dtype == jnp.uint32

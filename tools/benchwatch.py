@@ -148,6 +148,22 @@ def main() -> int:
                 # kernel vs bucket sorts vs compaction shares).
                 ("opshare-radixpart", [sys.executable, "tools/opshare.py"],
                  {**env, "OPSHARE_SORT_IMPL": "radix_partition"}),
+                # Family overhead rows (VERDICT r5 #5): every shipped
+                # family measured against plain wordcount on the SAME
+                # streamed corpus file — the BENCHMARKS.md overhead table.
+                ("family-plain", [sys.executable, "tools/familybench.py",
+                                  "plain"], env),
+                ("family-grep", [sys.executable, "tools/familybench.py",
+                                 "grep"], env),
+                ("family-sample", [sys.executable, "tools/familybench.py",
+                                   "sample"], env),
+                ("family-sketch", [sys.executable, "tools/familybench.py",
+                                   "sketch"], env),
+                # --verify-sample row (VERDICT r5 #6): K=64 byte-exact
+                # recount against the real bench corpus; the JSON line
+                # must carry verify_ok=true (zero mismatches, rc 0).
+                ("family-verify", [sys.executable, "tools/familybench.py",
+                                   "verify"], env),
             ]
             results = {name: run_step(args.out, name, cmd, e, 1800)
                        for name, cmd, e in steps}

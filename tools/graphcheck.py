@@ -5,10 +5,16 @@ Thin launcher for :mod:`mapreduce_tpu.analysis.cli` (also reachable as
 ``python -m mapreduce_tpu.analysis``), runnable from a source checkout
 without installation.  Exits non-zero on any error-severity finding.
 
+``--json`` emits the full machine-readable report for CI: structured
+findings plus the ``artifacts`` section (per-model HBM cost reports, the
+certified sort-pricing numbers, kernel VMEM footprints) — see
+docs/analysis.md for the schema.
+
 Usage::
 
-    python tools/graphcheck.py --all-models
-    python tools/graphcheck.py wordcount grep --json
+    python tools/graphcheck.py --all-models          # the CI gate
+    python tools/graphcheck.py wordcount grep --json # machine-readable
+    python tools/graphcheck.py --all-models --write-baselines
 """
 
 import os

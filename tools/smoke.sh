@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Fast iteration gate (VERDICT r5 #7): the <5-minute smoke subset — golden
+# semantics, CLI surface, table units, one pallas-interpret case, config
+# validation, and the costcheck known-bad fixtures — so a mid-PR edit gets
+# a signal in ~a minute instead of the ~12-minute tier-1 run.
+#
+# Green here is NOT the gate: tier-1 (tools/tier1.sh) stays the merge bar
+# and the full suite (no marker filter) the release bar.  Prints
+# DOTS_PASSED like tier1.sh and exits with pytest's status.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_smoke.log; timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'smoke and not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_smoke.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_smoke.log | tr -cd . | wc -c); exit $rc
