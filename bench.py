@@ -31,8 +31,10 @@ step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
 (device passes over the resident corpus in the timed dispatch, default 8),
 BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
-BENCH_SORT_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS (A/B knobs —
-measurement-altering, so BENCH_LAST_GOOD refuses them).
+BENCH_SORT_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS /
+BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
+so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
+dispatch-window control, see Config.inflight_groups).
 
 BENCH_LAST_GOOD.json additionally carries per-metric BEST-KNOWN records
 (headline / streamed / h2d, each timestamped) alongside the last run; a
@@ -274,10 +276,17 @@ _PARTIAL_RESULT: dict | None = None
 _WATCHDOG_DEADLINE: list = []  # single mutable slot: absolute deadline
 
 
-# The three metrics LAST_GOOD tracks value-aware best-known records for
-# (VERDICT r5 #2): result field -> record name.
-_BEST_METRICS = {"headline": "value", "streamed": "streamed_ingest_gbps",
-                 "h2d": "h2d_gbps"}
+# The metrics LAST_GOOD tracks value-aware best-known records for
+# (VERDICT r5 #2): record name -> (result field, lower_is_better).
+# `streamed_ratio` (ISSUE 5) is the tunnel-invariant streamed evidence in
+# its time form — streamed wall-clock over the same-run H2D floor
+# (`streamed_vs_h2d_time_ratio`, 1.0 = ingest at the link floor) — the
+# only LOWER-is-better record; the GB/s-over-GB/s `streamed_vs_h2d_ratio`
+# field stays in the JSON as its reciprocal.
+_BEST_METRICS = {"headline": ("value", False),
+                 "streamed": ("streamed_ingest_gbps", False),
+                 "h2d": ("h2d_gbps", False),
+                 "streamed_ratio": ("streamed_vs_h2d_time_ratio", True)}
 # Context keys that must match for two records to count as "an
 # otherwise-equal config" (the corpus/knob gates above already exclude
 # cross-corpus and A/B-knob writes entirely).
@@ -304,7 +313,7 @@ def _seed_best(prev: dict) -> dict:
     """Bootstrap best-known records from a pre-round-6 (value-blind)
     LAST_GOOD file so its evidence joins the new per-metric ledger."""
     best = {}
-    for name, field in _BEST_METRICS.items():
+    for name, (field, _) in _BEST_METRICS.items():
         if prev.get(field) is not None:
             best[name] = {"value": prev[field],
                           "recorded_at": prev.get("recorded_at"),
@@ -348,28 +357,33 @@ def _write_last_good(result: dict) -> None:
     best = dict(prev.get("best") or _seed_best(prev))
     force = os.environ.get("BENCH_FORCE_LAST_GOOD") == "1"
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    for name, field in _BEST_METRICS.items():
+    for name, (field, lower) in _BEST_METRICS.items():
         val = result.get(field)
         if val is None:
             continue
         rec = best.get(name)
         new_rec = {"value": val, "recorded_at": now,
                    **{k: result.get(k) for k in _BEST_CONTEXT}}
-        if rec is None or val >= rec.get("value", 0.0):
+        old = rec.get("value", float("inf") if lower else 0.0) \
+            if rec is not None else None
+        better = rec is None or (val <= old if lower else val >= old)
+        regressed = rec is not None and (
+            val > (1.0 + _REGRESSION_FRAC) * old if lower
+            else val < (1.0 - _REGRESSION_FRAC) * old)
+        if better:
             best[name] = new_rec
         elif force:
             # Deliberate re-baseline (e.g. after a harness change made old
             # records incomparable): the operator owns the downgrade.
             best[name] = new_rec
-        elif val < (1.0 - _REGRESSION_FRAC) * rec["value"] \
-                and _same_config(rec, result):
+        elif regressed and _same_config(rec, result):
             _log_refused(
                 f"metric '{name}' regressed {rec['value']} -> {val} "
                 f"(> {_REGRESSION_FRAC:.0%}) under an otherwise-equal "
                 "config; best-known record kept "
                 "(BENCH_FORCE_LAST_GOOD=1 overrides)")
         # Milder regressions (or config drift): best-known silently keeps
-        # the max — last-run fields below still record this run honestly.
+        # the best — last-run fields below still record this run honestly.
     try:
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump({**result, "recorded_at": now, "best": best}, f)
@@ -635,15 +649,26 @@ def main() -> int:
         streamed_gbps = None
         streamed_ledger = None
         streamed_metrics = None
+        streamed_pipeline = None
         if os.environ.get("BENCH_STREAMED", "1") != "0":
             try:
                 import dataclasses
 
                 from mapreduce_tpu.runtime import executor
 
+                # BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH: the ISSUE 5
+                # dispatch-window A/B knobs (1 = the serialized control;
+                # measurement-altering, so LAST_GOOD refuses them).
+                from mapreduce_tpu.config import Config as _Config
+
                 s_cfg = dataclasses.replace(
                     cfg, superstep=int(os.environ.get(
-                        "BENCH_STREAM_SUPERSTEP", "4")))
+                        "BENCH_STREAM_SUPERSTEP", "4")),
+                    inflight_groups=int(os.environ.get(
+                        "BENCH_INFLIGHT", str(_Config.inflight_groups))),
+                    prefetch_depth=(
+                        int(os.environ["BENCH_PREFETCH_DEPTH"])
+                        if os.environ.get("BENCH_PREFETCH_DEPTH") else None))
                 # Warm-up: a short-range run pays the XLA compiles for the
                 # streamed shapes (the persistent compile cache makes the
                 # timed run's identical programs cache hits), so the timed
@@ -690,10 +715,12 @@ def main() -> int:
                 streamed_ledger = ledger_path
                 streamed_metrics = _metrics_delta(
                     snap_before, obs.get_registry().snapshot())
+                streamed_pipeline = rr.pipeline
                 _log(f"streamed ingest pass done: {s_dt:.3f}s over "
                      f"{rr.metrics.bytes_processed >> 20} MB "
                      f"({streamed_gbps:.4f} GB/s end-to-end); "
-                     f"phases={streamed_phases}; ledger={ledger_path}", wall0)
+                     f"phases={streamed_phases}; pipeline={rr.pipeline}; "
+                     f"ledger={ledger_path}", wall0)
             except Exception as e:  # noqa: BLE001 — headline must survive
                 _log(f"streamed phase failed ({e!r}); keeping headline", wall0)
     finally:
@@ -706,6 +733,18 @@ def main() -> int:
         ratio = _streamed_ratio(result)
         if ratio is not None:
             result["streamed_vs_h2d_ratio"] = ratio
+            time_ratio = _time_ratio(ratio)
+            if time_ratio is not None:
+                result["streamed_vs_h2d_time_ratio"] = time_ratio
+        if streamed_pipeline is not None:
+            # Window forensics for the A/B rows: configured/observed
+            # in-flight depth and the overlap fraction (1 - blocked/stream).
+            result["streamed_overlap_fraction"] = \
+                streamed_pipeline.get("overlap_fraction")
+            result["streamed_pipeline"] = {
+                k: streamed_pipeline.get(k)
+                for k in ("inflight_groups", "prefetch_depth", "depth_mean",
+                          "depth_max", "window_filled", "full_frac")}
         if streamed_ledger:
             result["ledger"] = streamed_ledger
         # Registry DELTA over the timed streamed pass (the registry is
@@ -729,6 +768,18 @@ def _streamed_ratio(result: dict) -> float | None:
     if not streamed or not h2d:
         return None
     return round(streamed / h2d, 4)
+
+
+def _time_ratio(ratio: float | None) -> float | None:
+    """The same evidence in time form (ISSUE 5's falsifiable target):
+    streamed wall-clock over the same-run H2D floor, lower is better,
+    1.0 = ingest at the link floor.  A near-hung streamed pass can round
+    the GB/s ratio all the way to 0.0 — the time form is then
+    unrepresentable, not infinite: return None rather than crash the
+    headline result this late (this is the run most worth keeping)."""
+    if not ratio:
+        return None
+    return round(1.0 / ratio, 4)
 
 
 def _metrics_delta(before: dict, after: dict) -> dict:

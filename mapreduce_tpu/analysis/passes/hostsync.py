@@ -71,7 +71,64 @@ class HostSyncPass:
         step = ctx.engine_traces.get("step")
         if step is not None and not isinstance(step, trace.TraceFailure):
             out.extend(self._step_arg_findings(ctx, step))
+        out.extend(self._probe_findings(ctx))
         return out
+
+    def _probe_findings(self, ctx) -> list[core.Finding]:
+        """ISSUE 5: the executor's in-flight window launches one extra
+        program per dispatched group — the completion probe
+        (:func:`...runtime.executor._probe_body` over the smallest state
+        leaf).  Certify it stays a pure device-side copy: a callback or
+        infeed here would put a host round trip back into the no-retry hot
+        loop the window exists to pipeline."""
+        import jax
+        import numpy as _np
+
+        from mapreduce_tpu.runtime import executor as executor_mod
+
+        st = ctx.state_shape
+        if isinstance(st, trace.TraceFailure):
+            return []  # init_state failures are reported elsewhere
+        leaves = jax.tree.leaves(st)
+        if not leaves:
+            return []
+        leaf = min(leaves, key=lambda x: int(
+            _np.prod(x.shape, dtype=_np.int64)) * x.dtype.itemsize)
+        try:
+            traced = jax.make_jaxpr(executor_mod._probe_body)(
+                jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+        except Exception as e:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id,
+                model=ctx.model, hook="probe",
+                message=f"window completion probe does not trace: {e!r}",
+                hint="executor._probe_body must stay a trivial jittable "
+                     "copy of one state leaf")]
+        bad = []
+        n_eqns = 0
+        for eqn, _ in trace.iter_eqns(traced):
+            n_eqns += 1
+            name = eqn.primitive.name
+            if name in _CALLBACKS or name in _HOST_COUPLING:
+                bad.append(core.Finding(
+                    severity=core.ERROR, pass_id=self.pass_id,
+                    model=ctx.model, hook="probe",
+                    message=(f"'{name}' inside the window completion "
+                             "probe: every dispatched group would pay a "
+                             "host round trip, serializing the pipeline "
+                             "the in-flight window exists to build"),
+                    location=trace.eqn_location(eqn),
+                    hint="keep executor._probe_body a pure device-side "
+                         "copy; do telemetry host-side at retirement"))
+        if bad:
+            return bad
+        return [core.Finding(
+            severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+            hook="probe",
+            message=(f"window completion probe traces to {n_eqns} "
+                     f"equation(s) over {leaf.dtype}"
+                     f"[{','.join(map(str, leaf.shape))}]: no host "
+                     "coupling — the async window adds no hidden sync"))]
 
     def _program_findings(self, ctx, hook, traced) -> list[core.Finding]:
         out = []

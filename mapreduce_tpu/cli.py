@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--superstep", type=int, default=1, metavar="K",
                    help="with --stream: fold K chunks into one dispatch "
                         "(lax.scan) to amortize per-dispatch overhead")
+    p.add_argument("--inflight", type=int, default=Config.inflight_groups,
+                   metavar="W",
+                   help="with --stream: keep up to W superstep groups "
+                        "dispatched-but-unretired, so reader/staging/H2D "
+                        "and device compute of different groups overlap "
+                        "(1 = serialized dispatch, the safe fallback and "
+                        "A/B control; default %(default)s)")
+    p.add_argument("--prefetch-depth", type=int, default=None, metavar="N",
+                   help="with --stream: batches the background reader may "
+                        "run ahead (default auto: superstep * inflight, "
+                        "clamped to [2, 16] — co-tuned with the window)")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
     p.add_argument("--retry", type=int, default=0, metavar="N",
                    help="with --stream: retry a failed device step N times "
@@ -466,6 +477,8 @@ def main(argv: list[str] | None = None) -> int:
     try:
         config = Config(chunk_bytes=args.chunk_bytes, table_capacity=args.table_capacity,
                         backend=args.backend, superstep=args.superstep,
+                        inflight_groups=args.inflight,
+                        prefetch_depth=args.prefetch_depth,
                         pallas_max_token=args.max_token_bytes,
                         sketch_flush_every=args.sketch_flush_every,
                         sort_mode=args.sort_mode,

@@ -187,6 +187,29 @@ class Config:
     # are rescued exactly.  192 covers p99.9 of webby-proxy token lengths
     # (151 bytes); raise toward 320+ for URL-heavy corpora.
     rescue_window: int = 192
+    # Streaming dispatch window (ISSUE 5): how many superstep groups may be
+    # dispatched-but-unretired at once.  >1 pipelines the stream — reader,
+    # host staging, async H2D, and device compute of DIFFERENT groups
+    # overlap, and the executor blocks only when the window is full (or at
+    # checkpoint/file boundaries, where it drains) instead of eagerly per
+    # dispatch.  1 = strict serial (the safe fallback and the A/B control:
+    # dispatch -> retire -> next group).  With retry > 0 that reproduces
+    # the pre-window loop exactly (it synced every dispatch); the retry=0
+    # pre-window loop instead rode the device queue's own backpressure
+    # (async, no per-group sync), so 1 there is a strictly-more-serial
+    # control, not a bug-for-bug baseline.  With
+    # retry > 0 the window also sets the replay granularity: known-good
+    # snapshots move from per-group to window-drain points, so a mid-window
+    # failure replays at most the window (checkpoint boundaries still force
+    # a drain, keeping resume replay bounded by checkpoint_every).  Memory
+    # cost: up to inflight_groups * superstep * chunk_bytes of staged input
+    # per device kept live.
+    inflight_groups: int = 4
+    # Reader prefetch depth (batches the background reader may run ahead),
+    # co-tuned with the window: None (default) resolves to
+    # superstep * inflight_groups clamped to [2, 16] — enough host-side
+    # batches to keep a full window fed without unbounded buffering.
+    prefetch_depth: Optional[int] = None
     # Second-tier rescue budget (VERDICT r4 weak #4): URL-heavy text carries
     # ~15K overlong occurrences per 32 MB chunk (tools/overlong.py) — far
     # past the 1024-slot primary budget, which silently left >90% of them
@@ -267,6 +290,12 @@ class Config:
                     f"rescue_window must be <= 4096, got {self.rescue_window}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
+        if self.inflight_groups < 1:
+            raise ValueError(
+                f"inflight_groups must be >= 1, got {self.inflight_groups}")
+        if self.prefetch_depth is not None and self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
         if self.backend != "xla" and not 1 <= self.pallas_max_token <= 63:
             # 'auto' may resolve to pallas at runtime; fail at construction,
             # not mid-trace inside the kernel.  The kernel packs token length
@@ -322,6 +351,15 @@ class Config:
         transposed output block is a tile-aligned (128, 128) store), else
         the kernel's own default (None -> 256)."""
         return 384 if self.sort_mode == "stable2" else None
+
+    @property
+    def resolved_prefetch_depth(self) -> int:
+        """The resolved reader prefetch depth (see ``prefetch_depth``):
+        deep enough to feed a full dispatch window, bounded so host memory
+        stays O(window)."""
+        if self.prefetch_depth is not None:
+            return self.prefetch_depth
+        return min(16, max(2, self.superstep * self.inflight_groups))
 
     @property
     def pallas_min_chunk(self) -> int:

@@ -211,6 +211,10 @@ def prefetch(batches: Iterator[Batch], depth: int = 2) -> Iterator[Batch]:
     from mapreduce_tpu.obs import registry as _obs_registry
 
     reg = _obs_registry.get_registry()
+    # The configured depth is part of the pipeline telemetry (ISSUE 5):
+    # read_wait with a deep queue means the producer itself is the floor,
+    # with a shallow one it may just be the queue size.
+    reg.gauge("reader.prefetch_depth").set(depth)
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     _END, _ERR = object(), object()

@@ -200,13 +200,17 @@ class Telemetry:
 
     def step_record(self, *, step_first: int, step_last: int,
                     group_bytes: int, cursor_bytes: int, timer,
-                    retries: int = 0, write: bool = True) -> None:
+                    retries: int = 0, write: bool = True,
+                    inflight_depth: Optional[int] = None) -> None:
         """One ledger step record: phase-second DELTAS since the previous
         record (the timer accumulates run totals), elapsed wall-clock,
         device memory stats, and any compile events that landed in the
-        window.  ``write=False`` (non-coordinator processes in multi-host
-        runs) still advances the delta baseline so a later gate flip never
-        reports a cumulative blob as one step."""
+        window.  ``inflight_depth`` (ISSUE 5): how many dispatch groups
+        were in flight right after this one was enqueued — the per-step
+        sample behind the run-end depth statistics.  ``write=False``
+        (non-coordinator processes in multi-host runs) still advances the
+        delta baseline so a later gate flip never reports a cumulative
+        blob as one step."""
         if not self.enabled:
             return
         phases = {k: round(v - self._last_phases.get(k, 0.0), 6)
@@ -238,6 +242,8 @@ class Telemetry:
             rec["elapsed_s"] = elapsed
         if retries:
             rec["retries"] = retries
+        if inflight_depth is not None:
+            rec["inflight_depth"] = inflight_depth
         if compiles:
             rec["compile_events"] = compiles
         self.ledger.write("step", **rec)

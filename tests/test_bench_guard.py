@@ -138,3 +138,48 @@ def test_streamed_ratio_missing_or_zero_legs():
         {"streamed_ingest_gbps": 0.01, "h2d_gbps": 0.0}) is None
     assert bench._streamed_ratio(
         {"streamed_ingest_gbps": 0.0088, "h2d_gbps": 0.0194}) == 0.4536
+
+
+def test_time_ratio_zero_and_inverse():
+    """A near-hung streamed pass rounds the GB/s ratio to 0.0; the time
+    form must come back None (skipped from the result), never a
+    ZeroDivisionError after all the timed work."""
+    assert bench._time_ratio(None) is None
+    assert bench._time_ratio(0.0) is None
+    assert bench._time_ratio(0.0565) == round(1.0 / 0.0565, 4)
+    assert bench._time_ratio(1.0) == 1.0
+
+
+# -- the streamed time-ratio record (ISSUE 5: lower is better) ---------------
+
+
+def test_time_ratio_lower_is_better_record(last_good, capsys):
+    """streamed_vs_h2d_time_ratio (streamed wall over the same-run H2D
+    floor) records best-known with LOWER winning; mild (<25%) worsening
+    keeps the best silently."""
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 2.0})
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 1.4})
+    assert _read(last_good)["best"]["streamed_ratio"]["value"] == 1.4
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 1.5})
+    assert _read(last_good)["best"]["streamed_ratio"]["value"] == 1.4
+    assert "refused" not in capsys.readouterr().err
+
+
+def test_time_ratio_regression_refused_with_trace(last_good, capsys):
+    """>25% WORSENING (ratio growing) under an equal config refuses the
+    best-known displacement and says so on stderr — the same guard the
+    GB/s metrics carry, direction-flipped."""
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 1.4})
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 2.0})
+    rec = _read(last_good)
+    assert rec["best"]["streamed_ratio"]["value"] == 1.4  # evidence intact
+    assert rec["streamed_vs_h2d_time_ratio"] == 2.0  # last-run stays honest
+    err = capsys.readouterr().err
+    assert "refused" in err and "streamed_ratio" in err
+
+
+def test_time_ratio_force_rebaseline(last_good, monkeypatch):
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 1.4})
+    monkeypatch.setenv("BENCH_FORCE_LAST_GOOD", "1")
+    bench._write_last_good({**R5_GOOD, "streamed_vs_h2d_time_ratio": 2.0})
+    assert _read(last_good)["best"]["streamed_ratio"]["value"] == 2.0

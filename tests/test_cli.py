@@ -272,3 +272,18 @@ def test_merge_every_flag_validation(tmp_path):
     r = _run([str(f), "--stream", "--merge-every", "2", "--format", "json"])
     assert r.returncode == 0, r.stderr
     assert '"total": 3' in r.stdout
+
+
+@pytest.mark.smoke
+def test_inflight_and_prefetch_depth_flags_validate(tmp_path):
+    """ISSUE 5: the window knobs validate at the parser (clean exit 2
+    before any device work, not a mid-run traceback).  The streamed
+    pipelined-vs-serial identity itself is covered in test_executor.py —
+    no subprocess compile paid here."""
+    f = tmp_path / "in.txt"
+    f.write_text("a b a c\n")
+    for args in ([str(f), "--inflight", "0"],
+                 [str(f), "--prefetch-depth", "0"]):
+        r = _run(args)
+        assert r.returncode == 2, args
+        assert "must be >= 1" in r.stderr, args

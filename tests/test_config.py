@@ -114,3 +114,23 @@ def test_segmin_refused_on_tpu(monkeypatch):
     monkeypatch.setenv("MAPREDUCE_ALLOW_SEGMIN", "1")
     table_ops.from_packed_rows(k, k, p, jnp.uint32(0), 4, 0,
                                sort_mode="segmin")  # override path stays alive
+
+
+def test_inflight_groups_validation():
+    with pytest.raises(ValueError, match="inflight_groups"):
+        Config(inflight_groups=0)
+    with pytest.raises(ValueError, match="inflight_groups"):
+        Config(inflight_groups=-2)
+    assert Config(inflight_groups=1).inflight_groups == 1  # serial fallback
+    assert Config().inflight_groups >= 1
+
+
+def test_prefetch_depth_validation_and_resolution():
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        Config(prefetch_depth=0)
+    # explicit depth wins verbatim
+    assert Config(prefetch_depth=7).resolved_prefetch_depth == 7
+    # auto: co-tuned with the window (superstep * inflight), clamped [2, 16]
+    assert Config(superstep=1, inflight_groups=1).resolved_prefetch_depth == 2
+    assert Config(superstep=2, inflight_groups=3).resolved_prefetch_depth == 6
+    assert Config(superstep=8, inflight_groups=8).resolved_prefetch_depth == 16

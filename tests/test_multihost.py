@@ -228,3 +228,33 @@ def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     assert got["distinct"] == len(expected)
     assert got["counts"] == sorted(expected.values())
     assert got["processes"] == 2 and got["devices"] == 4
+
+
+@pytest.mark.slow
+def test_run_job_global_window_matches_serial(tmp_path, rng):
+    """ISSUE 5 on the global-SPMD driver: run_job_global (single process —
+    initialize() is a no-op, the global mesh is all local devices) with
+    the dispatch window active produces the identical result as the
+    serialized control, with pooled shard-row staging and the window
+    statistics in the run result."""
+    from tests.conftest import make_corpus
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.models.wordcount import WordCountJob
+    from mapreduce_tpu.runtime import executor
+
+    corpus = make_corpus(rng, n_words=2500, vocab=100)
+    path = tmp_path / "g.txt"
+    path.write_bytes(corpus)
+    dist.initialize()
+    totals = {}
+    for inflight in (1, 3):
+        cfg = Config(chunk_bytes=256, table_capacity=1024,
+                     inflight_groups=inflight)
+        rr = executor.run_job_global(WordCountJob(cfg), str(path), config=cfg)
+        assert rr.metrics.bytes_processed == len(corpus)
+        assert rr.pipeline["inflight_groups"] == inflight
+        counts = sorted(int(c) for c in np.asarray(rr.value.count) if c > 0)
+        totals[inflight] = (rr.metrics.words_counted, counts)
+    assert totals[1] == totals[3]
+    assert totals[1][0] == oracle.total_count(corpus)
+    assert totals[1][1] == sorted(oracle.word_counts(corpus).values())

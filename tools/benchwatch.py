@@ -108,6 +108,16 @@ def main() -> int:
                  env),
                 # Defaults row = stable2 since round 5 (+5.9% measured).
                 ("bench-zipf", [sys.executable, "bench.py"], env),
+                # ISSUE 5 dispatch-window A/B: streamed ingest with the
+                # bounded in-flight window at depth 4 vs forced-serial
+                # (BENCH_INFLIGHT=1), so the first live window measures
+                # the window on/off delta directly.  Both rows keep the
+                # streamed post-phase — it IS the measurement — and both
+                # are A/B evidence (LAST_GOOD refuses the knob).
+                ("bench-zipf-pipeline", [sys.executable, "bench.py"],
+                 {**env, "BENCH_INFLIGHT": "4"}),
+                ("bench-zipf-nopipeline", [sys.executable, "bench.py"],
+                 {**env, "BENCH_INFLIGHT": "1"}),
                 # Regression A/B rows: the previous default (sort3) and the
                 # uncompacted path.  segmin's stream-sized associative_scan
                 # wedges the chip (3 observations, BENCHMARKS.md round 4) —

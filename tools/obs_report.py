@@ -80,10 +80,12 @@ def _mem_bytes(mem: dict):
 
 
 def classify(phases: dict) -> str:
-    """Bound classification over the streaming phases (not drain/reduce:
-    they time the stream END, not the steady state)."""
+    """Bound classification over the streaming phases (not the end-of-
+    stream tails or reduce: they time the stream END, not the steady
+    state).  ``retire_wait`` — blocked on a full dispatch window — means
+    the device is the ceiling and the window is doing its job."""
     streaming = {k: phases.get(k, 0.0)
-                 for k in ("read_wait", "stage", "dispatch")}
+                 for k in ("read_wait", "stage", "dispatch", "retire_wait")}
     total = sum(streaming.values())
     if total <= 0:
         return "unknown"
@@ -91,7 +93,72 @@ def classify(phases: dict) -> str:
     if val / total < 0.5:
         return "mixed"
     return {"read_wait": "read-bound", "stage": "stage-bound",
-            "dispatch": "dispatch-bound"}[name]
+            "dispatch": "dispatch-bound",
+            "retire_wait": "device-bound"}[name]
+
+
+# Pipelining health thresholds (ISSUE 5): a run whose end-of-stream tails
+# eat this share of the stream is drain-heavy (the window stopped feeding
+# the device long before the stream ended); an overlap fraction below the
+# floor means the loop spent most of the stream blocked — serialized.
+DRAIN_HEAVY_FRAC = 0.25
+OVERLAP_FLOOR = 0.5
+
+
+def pipeline_flags(phases: dict, pipeline: dict | None) -> list:
+    """Window-health findings from the run-end pipeline stats + phases:
+    drain-heavy / overlap-starved runs and inflight misconfiguration
+    (window never filled vs always full)."""
+    flags = []
+    stream = phases.get("stream") or sum(
+        phases.get(k, 0.0) for k in ("read_wait", "stage", "dispatch",
+                                     "retire_wait", "h2d_tail",
+                                     "compute_tail", "drain"))
+    tails = phases.get("h2d_tail", 0.0) + phases.get("compute_tail", 0.0) \
+        + phases.get("drain", 0.0)
+    if stream > 0 and tails / stream > DRAIN_HEAVY_FRAC:
+        flags.append({
+            "flag": "drain-heavy",
+            "detail": (f"end-of-stream tails are {tails:.3f}s of "
+                       f"{stream:.3f}s stream "
+                       f"(h2d_tail={phases.get('h2d_tail', 0.0):.3f}s, "
+                       f"compute_tail={phases.get('compute_tail', 0.0):.3f}s)"
+                       " — the device finished the stream long after the "
+                       "reader; deepen --inflight/--prefetch-depth or "
+                       "shrink the superstep")})
+    overlap = (pipeline or {}).get("overlap_fraction")
+    if overlap is None and stream > 0:
+        blocked = sum(phases.get(k, 0.0)
+                      for k in ("read_wait", "retire_wait", "snapshot",
+                                "h2d_tail", "compute_tail"))
+        overlap = max(0.0, 1.0 - blocked / stream)
+    if overlap is not None and overlap < OVERLAP_FLOOR:
+        flags.append({
+            "flag": "overlap-starved",
+            "detail": (f"overlap fraction {overlap:.2f} < {OVERLAP_FLOOR}: "
+                       "the driver loop spent most of the stream blocked "
+                       "(serialized dispatch?); check inflight_groups > 1 "
+                       "and the read_wait share")})
+    if pipeline:
+        cap = pipeline.get("inflight_groups") or 0
+        depth_max = pipeline.get("depth_max")
+        if cap > 1 and depth_max is not None and depth_max < cap:
+            flags.append({
+                "flag": "inflight-window-never-filled",
+                "detail": (f"configured inflight_groups={cap} but observed "
+                           f"depth peaked at {depth_max}: the reader/"
+                           "staging side never fed a full window — the "
+                           "extra depth buys nothing (raise prefetch_depth "
+                           "or lower inflight_groups)")})
+        full_frac = pipeline.get("full_frac")
+        if cap > 1 and full_frac is not None and full_frac >= 0.9:
+            flags.append({
+                "flag": "inflight-window-always-full",
+                "detail": (f"window hit capacity on {full_frac:.0%} of "
+                           "dispatches: the device is the ceiling — a "
+                           "deeper window may overlap more (or this is "
+                           "simply compute-bound)")})
+    return flags
 
 
 def analyze_run(records: list) -> dict:
@@ -146,7 +213,11 @@ def analyze_run(records: list) -> dict:
     gbps = None
     if wall and bytes_done:
         gbps = bytes_done / 1e9 / wall
+    pipeline = end.get("pipeline") if end else None
     return {
+        "pipeline": pipeline,
+        "overlap_fraction": (pipeline or {}).get("overlap_fraction"),
+        "pipeline_flags": pipeline_flags(phases, pipeline),
         "run_id": records[0].get("run_id"),
         "header": {k: start.get(k) for k in
                    ("driver", "job", "devices", "chunk_bytes", "superstep",
@@ -197,18 +268,29 @@ def render_run(a: dict, out) -> None:
         out.write(f", {a['gb_per_s']:.4f} GB/s")
     out.write("\n")
     if a["phases"]:
+        streaming = ("read_wait", "stage", "dispatch", "retire_wait")
         total = sum(v for k, v in a["phases"].items()
-                    if k in ("read_wait", "stage", "dispatch")) or 1.0
+                    if k in streaming) or 1.0
         parts = []
         for k, v in a["phases"].items():
-            share = f" ({100 * v / total:.0f}%)" \
-                if k in ("read_wait", "stage", "dispatch") else ""
+            share = f" ({100 * v / total:.0f}%)" if k in streaming else ""
             parts.append(f"{k}={v:.3f}s{share}")
         out.write(f"  phases: {'  '.join(parts)}\n")
     out.write(f"  bound: {a['classification']}")
     if a["compile_s"]:
         out.write(f"  (compiles: {a['compile_s']:.2f}s)")
     out.write("\n")
+    p = a.get("pipeline")
+    if p:
+        out.write(f"  pipeline: inflight={p.get('inflight_groups')}  "
+                  f"prefetch={p.get('prefetch_depth')}  "
+                  f"depth mean/max={p.get('depth_mean')}/"
+                  f"{p.get('depth_max')}")
+        if a.get("overlap_fraction") is not None:
+            out.write(f"  overlap={a['overlap_fraction']:.2f}")
+        out.write("\n")
+    for f in a.get("pipeline_flags", []):
+        out.write(f"  PIPELINE {f['flag']}: {f['detail']}\n")
     if a["checkpoints"] or a["retries"]:
         out.write(f"  checkpoints: {a['checkpoints']}  "
                   f"retries: {a['retries']}\n")
@@ -252,7 +334,7 @@ def selftest() -> int:
     ledger = os.path.join(fdir, "mini_ledger.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 1, f"fixture holds one run, got {len(runs)}"
+    assert len(runs) == 2, f"fixture holds two runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -263,19 +345,38 @@ def selftest() -> int:
     assert a["mem_growth"] and a["mem_growth"]["ratio"] > 4, a["mem_growth"]
     assert a["retries"] == 1 and a["checkpoints"] == 1
     assert a["compile_s"] > 0.5, a["compile_s"]
+    # Run 1: the window was configured but never filled, and the loop was
+    # mostly blocked — both ISSUE 5 misconfiguration flags must fire.
+    assert a["pipeline"]["inflight_groups"] == 4
+    assert a["overlap_fraction"] == 0.31
+    flags = {f["flag"] for f in a["pipeline_flags"]}
+    assert flags == {"overlap-starved", "inflight-window-never-filled"}, flags
+    # Run 2: window always full + fat end-of-stream tails -> drain-heavy
+    # and always-full, but NOT never-filled.
+    b = runs[1]
+    assert b["classification"] == "device-bound", b["classification"]
+    bflags = {f["flag"] for f in b["pipeline_flags"]}
+    assert bflags == {"drain-heavy", "overlap-starved",
+                      "inflight-window-always-full"}, bflags
     # The human renderer must run over both artifacts without raising.
     import io
 
     buf = io.StringIO()
     render_run(a, buf)
+    render_run(b, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert "ANOMALY step-time spike" in body
     assert "ANOMALY memory growth" in body
     assert "injected device fault" in body
+    assert "PIPELINE inflight-window-never-filled" in body
+    assert "PIPELINE drain-heavy" in body
+    assert "pipeline: inflight=4" in body
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
-          "1 memory-growth flag)")
+          "1 memory-growth flag, "
+          f"{len(a['pipeline_flags']) + len(b['pipeline_flags'])} "
+          "pipeline flags)")
     return 0
 
 
