@@ -31,10 +31,18 @@ step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
 (device passes over the resident corpus in the timed dispatch, default 8),
 BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
-BENCH_SORT_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS /
+BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS /
 BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
 so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
-dispatch-window control, see Config.inflight_groups).
+dispatch-window control, see Config.inflight_groups; BENCH_MAP_IMPL=fused
+runs the ISSUE 6 fused map kernel, see Config.map_impl).
+
+BENCH JSON carries a `cost` record: the static hbm-cost pricing
+(`effective_input_passes`) of the benched map path's registry twin
+(wordcount_fused vs wordcount_pallas), so every bench row states the
+predicted HBM-pass count next to the measured GB/s — the fused-vs-split
+A/B rows in benchwatch read the predicted delta and the measured delta
+from the same JSON.
 
 BENCH_LAST_GOOD.json additionally carries per-metric BEST-KNOWN records
 (headline / streamed / h2d, each timestamped) alongside the last run; a
@@ -541,6 +549,8 @@ def main() -> int:
                                           Config.sort_mode),
                  sort_impl=os.environ.get("BENCH_SORT_IMPL",
                                           Config.sort_impl),
+                 map_impl=os.environ.get("BENCH_MAP_IMPL",
+                                         Config.map_impl),
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ
@@ -727,6 +737,13 @@ def main() -> int:
         os.unlink(path)
 
     result = dict(_PARTIAL_RESULT)
+    # Static cost pricing of the benched map path (ISSUE 6): predicted HBM
+    # passes next to the measured GB/s, so the fused-vs-split A/B rows
+    # carry the prediction and the measurement in one JSON.
+    result["map_impl"] = cfg.map_impl
+    cost = _cost_record(cfg.map_impl)
+    if cost is not None:
+        result["cost"] = cost
     if streamed_gbps is not None:
         result["streamed_ingest_gbps"] = round(streamed_gbps, 4)
         result["streamed_phases"] = streamed_phases
@@ -780,6 +797,34 @@ def _time_ratio(ratio: float | None) -> float | None:
     if not ratio:
         return None
     return round(1.0 / ratio, 4)
+
+
+def _cost_record(map_impl: str) -> dict | None:
+    """Static hbm-cost pricing of the benched map path (ISSUE 6): run the
+    analysis cost pass over the registry twin of the benched config
+    (wordcount_fused when BENCH_MAP_IMPL=fused, else wordcount_pallas) and
+    surface `effective_input_passes` — plus the fused-vs-split gap the
+    pass certifies — in BENCH JSON.  Pure tracing, no device work; any
+    failure is logged and skipped (the measured row must survive)."""
+    try:
+        from mapreduce_tpu import analysis, models
+        from mapreduce_tpu.analysis.passes.cost import CostPass
+
+        name = ("wordcount_fused" if map_impl == "fused"
+                else "wordcount_pallas")
+        rep = analysis.analyze_job(models.build_model(name), name,
+                                   passes=[CostPass()])
+        art = rep.artifacts.get(name, {}).get("cost")
+        if not art:
+            return None
+        rec = {"model": name,
+               "effective_input_passes": art.get("effective_input_passes")}
+        if "fused_vs_split" in art:
+            rec["fused_vs_split"] = art["fused_vs_split"]
+        return rec
+    except Exception as e:  # noqa: BLE001 — advisory, never fatal
+        print(f"[bench] cost artifact skipped ({e!r})", file=sys.stderr)
+        return None
 
 
 def _metrics_delta(before: dict, after: dict) -> dict:

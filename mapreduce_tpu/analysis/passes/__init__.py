@@ -1,7 +1,8 @@
 """Built-in graphcheck passes.  Import order = pipeline run order."""
 
 from mapreduce_tpu.analysis.passes import (algebra, overflow, hostsync,
-                                           sharding, cost, vmem, kernelrace)
+                                           sharding, cost, vmem, kernelrace,
+                                           fusion)
 
 __all__ = ["algebra", "overflow", "hostsync", "sharding", "cost", "vmem",
-           "kernelrace"]
+           "kernelrace", "fusion"]

@@ -25,6 +25,15 @@ Three jobs, all CI-gateable:
    beyond ``REGRESSION_TOLERANCE`` (20%) fails the pipeline unless the
    baselines are intentionally regenerated (``--write-baselines``); a
    SHRINK past the same margin is only a warning nudging a re-baseline.
+
+4. **Fused-vs-split gate** (ISSUE 6): a model whose config runs the fused
+   map path (``Config.map_impl='fused'``) must price STRICTLY below its
+   split-path counterpart's checked-in baseline — the machine-checked
+   before/after that certifies the fusion actually deleted HBM traffic
+   instead of moving it.  Counterpart pairs are declared in
+   ``_SPLIT_COUNTERPART``; a fused model without one is an ERROR too (an
+   ungated fusion is exactly the unmeasured claim this pass exists to
+   forbid).
 """
 
 from __future__ import annotations
@@ -35,6 +44,11 @@ import os
 from mapreduce_tpu.analysis import core, costmodel, trace
 
 REGRESSION_TOLERANCE = 0.20
+
+# Fused-map registry models gated against their split-path twin's baseline
+# (same chunk geometry, Config.map_impl the only delta — see
+# models.FUSED_ANALYSIS_CONFIG).
+_SPLIT_COUNTERPART = {"wordcount_fused": "wordcount_pallas"}
 
 _BASELINES_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "baselines")
@@ -95,6 +109,7 @@ class CostPass:
 
         out.extend(self._sort_findings(ctx, report))
         out.extend(self._baseline_findings(ctx, report))
+        out.extend(self._fused_gate_findings(ctx, report))
         ctx.artifacts["cost"] = report
         return out
 
@@ -178,6 +193,91 @@ class CostPass:
                      f"{lo:.2f}-{hi:.2f} effective HBM passes "
                      f"(claimed {claimed_lo}-{claimed_hi})"),
             location=sort.location)]
+
+    # -- fused-vs-split gate (ISSUE 6) ----------------------------------
+
+    def _fused_gate_findings(self, ctx, report) -> list[core.Finding]:
+        config = getattr(ctx.job, "config", None)
+        passes = report.get("effective_input_passes")
+        if config is None or passes is None or config.map_impl != "fused" \
+                or config.resolved_backend() != "pallas":
+            return []
+        split_model = _SPLIT_COUNTERPART.get(ctx.model)
+        if split_model is None:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message="fused map path with no declared split counterpart: "
+                        "the fusion's win is unmeasured",
+                hint="add the pair to cost._SPLIT_COUNTERPART so the gate "
+                     "prices the fusion against its split baseline")]
+        split = load_baseline(split_model, ctx.baselines_dir)
+        if split is None:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"split counterpart {split_model!r} has no cost "
+                         "baseline: the fused-vs-split gap cannot be gated"),
+                hint=f"regenerate with `python -m mapreduce_tpu.analysis "
+                     f"{split_model} --write-baselines` and commit the JSON")]
+        split_raw = split.get("effective_input_passes")
+        if not isinstance(split_raw, (int, float)) or split_raw <= 0:
+            # A broken baseline must name itself: falling through would
+            # publish a nonsense gap and misdiagnose as "the fusion
+            # stopped deleting traffic".
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"split counterpart {split_model!r} baseline has "
+                         f"no usable effective_input_passes "
+                         f"({split_raw!r}): the fused-vs-split gap cannot "
+                         "be gated"),
+                hint=f"regenerate with `python -m mapreduce_tpu.analysis "
+                     f"{split_model} --write-baselines` and commit the JSON")]
+        split_ref = float(split_raw)
+        if split.get("traced_chunk_bytes") != report["traced_chunk_bytes"]:
+            # Do NOT publish a gap: bench._cost_record copies the artifact
+            # verbatim, and a passes_saved the gate just declared
+            # incomparable must not reach BENCH JSON / benchwatch rows.
+            # A baseline MISSING the field is incomparable too — a wildcard
+            # match would wave through a different-geometry pricing.
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"fused model traces a "
+                         f"{report['traced_chunk_bytes']}-byte chunk but the "
+                         f"split counterpart's baseline priced "
+                         f"{split.get('traced_chunk_bytes')!r}: the passes "
+                         "are not comparable"),
+                hint="keep FUSED_ANALYSIS_CONFIG and the split model's "
+                     "config on the same chunk geometry (regenerate the "
+                     "baseline if it predates geometry recording)")]
+        # Geometry certified comparable: publish the gap (bench copies it
+        # into BENCH JSON; a LOSING gap still publishes — it is comparable
+        # evidence, and the ERROR below gates it).
+        report["fused_vs_split"] = {
+            "split_model": split_model,
+            "split_effective_input_passes": split_ref,
+            "fused_effective_input_passes": passes,
+            "passes_saved": round(split_ref - passes, 3)}
+        if passes >= split_ref:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"fused map path prices {passes:.2f} effective HBM "
+                         f"passes, NOT strictly below the split baseline "
+                         f"{split_ref:.2f} ({split_model}): the fusion "
+                         "stopped deleting traffic"),
+                hint="the token-plane round-trip crept back in (or the "
+                     "split baseline is stale); fix the kernel path or "
+                     "re-measure deliberately, BENCHMARKS.md discipline")]
+        return [core.Finding(
+            severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+            hook="step",
+            message=(f"fusion certified: {passes:.2f} effective HBM passes "
+                     f"vs split baseline {split_ref:.2f} ({split_model}) — "
+                     f"{split_ref - passes:.2f} passes of token-plane "
+                     "round-trip deleted"))]
 
     # -- baseline regression gate ---------------------------------------
 

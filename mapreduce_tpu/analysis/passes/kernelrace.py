@@ -105,6 +105,12 @@ class KernelRacePass:
                     hint="inputs are read-only views of the HBM operand; "
                          "stage through scratch or an output"))
                 continue
+            if ref.role == "in":
+                # Revisited INPUT blocks (an index map pinning every
+                # iteration to the same operand block — the fused seam-aux
+                # plane) are re-fetched from HBM, never uninitialized, and
+                # unwritable per the check above: no cross-iteration hazard.
+                continue
             if not ref.revisited:
                 # Disjoint blocks per iteration: blind writes are the
                 # normal output pattern; nothing cross-iteration to race.

@@ -151,6 +151,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sort-mode, applies to the packed fast path only "
                         "(pallas wordcount family + gram builds); the xla "
                         "wordcount path runs the generic build either way")
+    p.add_argument("--map-impl", choices=("split", "fused"), default="split",
+                   help="pallas map-phase implementation (bit-identical "
+                        "results): 'split' = compact kernel + XLA seam "
+                        "fix-up over 129 seam windows (the shipped path); "
+                        "'fused' = tokenize -> hash -> window compaction in "
+                        "ONE kernel pass over raw chunk bytes, lane seams "
+                        "resolved in-VMEM from a seam-carry plane — no "
+                        "token-plane round-trip to HBM before the "
+                        "aggregation sort (costcheck prices the gap; "
+                        "'split' stays default until the on-chip window "
+                        "confirms the predicted win, BENCHMARKS.md round 9)")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -483,6 +494,7 @@ def main(argv: list[str] | None = None) -> int:
                         sketch_flush_every=args.sketch_flush_every,
                         sort_mode=args.sort_mode,
                         sort_impl=args.sort_impl,
+                        map_impl=args.map_impl,
                         merge_every=args.merge_every,
                         compact_slots=args.compact_slots,
                         rescue_overlong=args.rescue_overlong,

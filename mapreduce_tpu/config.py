@@ -156,6 +156,27 @@ class Config:
     # same generic sort twice — run radix A/Bs on the pallas path, as
     # bench.py does).
     sort_impl: str = "xla"
+    # Map-phase IMPLEMENTATION for the pallas backend (ISSUE 6) — the seam
+    # between "tokenize with an XLA fix-up chain" and "one fused kernel".
+    # 'split' (default): the round-4/5 shipped path — the compact kernel
+    # emits column planes, 128-lane-seam tokens are re-tokenized by an XLA
+    # scan over 129 seam windows (a second read of seam bytes from HBM +
+    # a per-chunk seam-table merge), and the input is transposed/padded to
+    # the column view in XLA before the kernel (two more materializing
+    # passes over the chunk).  'fused' consumes the RAW lane view and
+    # resolves lane seams IN-KERNEL from a small seam-carry plane
+    # (ops/pallas/tokenize.tokenize_fused): tokenize -> hash -> window
+    # compaction in one pallas_call, one stream straight into the
+    # aggregation sort — no token-plane fix-up round-trip.  Results are
+    # bit-identical (tests/test_fused.py), overlong-rescue and the
+    # spill->exact fallback included (the fused fallback is the same
+    # kernel in pair mode).  The costcheck hbm-cost pass prices the gap
+    # and ERROR-gates `wordcount_fused` strictly below the split baseline;
+    # 'split' stays default until an on-chip window confirms the predicted
+    # win (BENCHMARKS.md round 9 — the radix round-6 discipline).  Applies
+    # to the pallas map paths (wordcount family + n-grams); the xla
+    # backend has no kernel to fuse and ignores it.
+    map_impl: str = "split"
     # Slot-compact the pallas kernel's column planes to S output rows per
     # block_rows-byte (block, lane) window instead of the pair path's
     # block_rows/2 (VERDICT r4 #2: the sort floor is row-count-bound).  At
@@ -238,6 +259,8 @@ class Config:
             raise ValueError(f"unknown sort_mode {self.sort_mode!r}")
         if self.sort_impl not in ("xla", "radix", "radix_partition"):
             raise ValueError(f"unknown sort_impl {self.sort_impl!r}")
+        if self.map_impl not in ("split", "fused"):
+            raise ValueError(f"unknown map_impl {self.map_impl!r}")
         if self.sort_impl != "xla" and self.sort_mode == "segmin":
             raise ValueError(
                 "sort_impl='radix'/'radix_partition' requires sort_mode "

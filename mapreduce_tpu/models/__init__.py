@@ -42,6 +42,16 @@ RADIX_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 66, table_capacity=512,
 PALLAS_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 384, table_capacity=512,
                                 backend="pallas")
 
+# Fused map path (ISSUE 6): the same production-shaped stable2 pallas
+# program with Config.map_impl='fused' — tokenize -> hash -> window
+# compaction in ONE pallas_call, no token-plane round-trip to HBM.  Same
+# chunk geometry as PALLAS_ANALYSIS_CONFIG so the hbm-cost pass's
+# `effective_input_passes` is directly comparable: the cost pass ERROR-
+# gates this model strictly below the split-path wordcount_pallas
+# baseline (the machine-checked before/after of the fusion).
+FUSED_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 384, table_capacity=512,
+                               backend="pallas", map_impl="fused")
+
 
 def _wordcount(config: Config):
     from mapreduce_tpu.models.wordcount import WordCountJob
@@ -94,6 +104,16 @@ def _wordcount_pallas(config: Config):
     return WordCountJob(PALLAS_ANALYSIS_CONFIG)
 
 
+def _wordcount_fused(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config (see _wordcount_radix): the model exists to put the
+    # fused map program in front of the full graphcheck/costcheck gate,
+    # with its cost baseline error-gated below the split path's.
+    del config
+    return WordCountJob(FUSED_ANALYSIS_CONFIG)
+
+
 _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount": _wordcount,
     "grep": _grep,
@@ -102,6 +122,7 @@ _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "sketch": _sketch,
     "wordcount_radix": _wordcount_radix,
     "wordcount_pallas": _wordcount_pallas,
+    "wordcount_fused": _wordcount_fused,
 }
 
 
@@ -119,5 +140,6 @@ def build_model(name: str, config: Config = ANALYSIS_CONFIG):
     return factory(config)
 
 
-__all__ = ["ANALYSIS_CONFIG", "PALLAS_ANALYSIS_CONFIG",
-           "RADIX_ANALYSIS_CONFIG", "build_model", "model_names"]
+__all__ = ["ANALYSIS_CONFIG", "FUSED_ANALYSIS_CONFIG",
+           "PALLAS_ANALYSIS_CONFIG", "RADIX_ANALYSIS_CONFIG",
+           "build_model", "model_names"]

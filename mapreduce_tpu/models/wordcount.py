@@ -179,22 +179,32 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
         concat_sort_mode = "sort3" if config.sort_mode == "stable2" \
             else config.sort_mode
 
-        def aggregate(col, seam, overlong):
-            # One aggregation over column + seam emissions together: the
-            # seam rows are ~8.5K entries, absorbed by the big sort for
-            # free, where a separate seam table + merge cost a second
-            # (fixed-overhead-bound) reduce pass per chunk.
-            stream = pallas_tok.concat_streams(col, seam)
+        def aggregate_stream(stream, overlong, mode):
+            """ONE packed build over a single complete stream — the shared
+            tail of the split concat path and the fused map path (whose
+            kernel already holds every emission, cross-lane-seam tokens
+            hashed in-kernel from the seam-carry plane): no seam table, no
+            seam merge, and overlong poison rows ride the big sort's
+            poison segment (contrast aggregate_stable2's seam-poison
+            extraction dance)."""
             built = table_ops.from_stream(
                 stream, capacity, pos_hi=pos_hi,
                 max_token_bytes=config.pallas_max_token,
-                max_pos=int(chunk.shape[0]), sort_mode=concat_sort_mode,
+                max_pos=int(chunk.shape[0]), sort_mode=mode,
                 rescue_slots=config.rescue_slots_max,
                 sort_impl=config.sort_impl)
             if not config.rescue_slots:
                 return accounted(built, overlong)
             t, rescue_packed = built
             return rescued_table(t, rescue_packed, overlong)
+
+        def aggregate(col, seam, overlong):
+            # One aggregation over column + seam emissions together: the
+            # seam rows are ~8.5K entries, absorbed by the big sort for
+            # free, where a separate seam table + merge cost a second
+            # (fixed-overhead-bound) reduce pass per chunk.
+            return aggregate_stream(pallas_tok.concat_streams(col, seam),
+                                    overlong, concat_sort_mode)
 
         def aggregate_stable2(col, seam, overlong):
             """Split aggregation for the lane-major layout: the column
@@ -250,20 +260,49 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 return SeamedUpdate(batch=t, seam=seam_tbl)
             return table_ops.merge(t, seam_tbl, capacity=capacity)
 
-        def full_path(_):
-            col, seam, overlong = pallas_tok.tokenize_split(
-                chunk, max_token_bytes=config.pallas_max_token)
-            t = aggregate(col, seam, overlong)
+        def seamed(t):
+            """Match the split-seam pytree for paths with no seam table to
+            defer: an empty seam table rides along, inert in the caller's
+            three-way merge."""
             if split_seam:
-                # Match the split branch's pytree: the fallback has already
-                # folded its seam rows, so an empty seam table rides along
-                # (inert in the caller's three-way merge).
                 return SeamedUpdate(
                     batch=t,
                     seam=table_ops.empty(min(
                         capacity,
                         _seam_table_cap(config.pallas_max_token))))
             return t
+
+        def full_path(_):
+            col, seam, overlong = pallas_tok.tokenize_split(
+                chunk, max_token_bytes=config.pallas_max_token)
+            return seamed(aggregate(col, seam, overlong))
+
+        if config.map_impl == "fused":
+            def fused_full(_):
+                # Spill fallback = the SAME fused kernel in pair mode
+                # (full resolution, exact).  Pair-layout streams interleave
+                # lanes, so first occurrence needs the third sort key.
+                stream, overlong, _sp = pallas_tok.tokenize_fused(
+                    chunk, max_token_bytes=config.pallas_max_token)
+                return seamed(aggregate_stream(stream, overlong,
+                                               concat_sort_mode))
+
+            if not config.resolved_compact_slots:
+                return fused_full(None)
+            lane_major = config.sort_mode == "stable2"
+            stream, overlong, spill = pallas_tok.tokenize_fused(
+                chunk, compact_slots=config.resolved_compact_slots,
+                max_token_bytes=config.pallas_max_token,
+                block_rows=config.resolved_block_rows,
+                lane_major=lane_major)
+            # Lane-major fused streams stay in global byte-position order
+            # (cross-seam tokens land in their start-position slot), so the
+            # stable2 tie-order contract holds over the single stream.
+            mode = "stable2" if lane_major else concat_sort_mode
+            return jax.lax.cond(
+                spill == 0,
+                lambda _: seamed(aggregate_stream(stream, overlong, mode)),
+                fused_full, None)
 
         if not config.resolved_compact_slots:
             return full_path(None)

@@ -17,7 +17,7 @@ Record kinds (full schema: docs/observability.md):
 kind           carries
 =============  ===========================================================
 run_start      run_id, config summary (devices, chunk_bytes, superstep,
-               backend, input paths), resume cursor
+               backend, map_impl, input paths), resume cursor
 step           step_first/step_last/steps, group_bytes, cursor_bytes,
                per-phase second deltas (read_wait/stage/dispatch/...),
                elapsed_s since the previous record, device memory stats,

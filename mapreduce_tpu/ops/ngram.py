@@ -215,9 +215,20 @@ def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
     in-chunk gram formation and the seam summary."""
     from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
 
-    col, seam, overlong = pallas_tok.tokenize_split(
-        chunk, max_token_bytes=config.pallas_max_token)
-    stream = pallas_tok.concat_streams(col, seam)
+    if config.map_impl == "fused":
+        # Fused map (Config.map_impl): one kernel pass emits the whole
+        # stream — cross-lane-seam tokens hashed in-kernel — so the
+        # position sort consumes it directly, no seam concat.  The gram
+        # family keeps full resolution (pair mode): its consumer is the
+        # position sort, which any row order feeds equally well, and the
+        # pair path is spill-free by construction (exactness without a
+        # fallback cond).  Poison rows ride the same stream.
+        stream, overlong, _spill = pallas_tok.tokenize_fused(
+            chunk, max_token_bytes=config.pallas_max_token)
+    else:
+        col, seam, overlong = pallas_tok.tokenize_split(
+            chunk, max_token_bytes=config.pallas_max_token)
+        stream = pallas_tok.concat_streams(col, seam)
     key_hi, key_lo, packed = position_sorted(stream)
     gs = mark_long_spans(grams_from_sorted(key_hi, key_lo, packed, n))
     t = gram_table(gs, capacity, pos_hi, max_pos=chunk.shape[0],

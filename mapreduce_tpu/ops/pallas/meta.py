@@ -118,6 +118,10 @@ def production_plans() -> list[VmemPlan]:
                            lane_major=True),   # stable2 default
         tokenize.vmem_plan(block_rows=256, compact_slots=88),  # sort3 compact
         tokenize.vmem_plan(block_rows=256, compact_slots=0),   # pair path
+        tokenize.vmem_plan(block_rows=384, compact_slots=128,
+                           lane_major=True, fused=True),  # fused map path
+        tokenize.vmem_plan(block_rows=256, compact_slots=0,
+                           fused=True),        # fused spill fallback (pair)
         radix.vmem_plan(),                                     # default B=8
         radix.vmem_plan(bits=5),                               # widest legal B
     ]

@@ -58,6 +58,17 @@ LANES = 128
 DEFAULT_MAX_TOKEN = 32  # W: max token bytes handled fully on the fast path
 DEFAULT_BLOCK_ROWS = 256
 
+# Fused-path seam-carry plane (one small second kernel input): rows
+# [0, w+1) hold each lane's PREVIOUS lane's last w+1 bytes (PAD for lane
+# 0), rows [AUX_HEAD_ROW, AUX_ROWS) hold the NEXT lane's first byte
+# replicated (PAD for lane 127).  96 rows keep the uint8 block on the
+# (32, 128) tile grid; AUX_HEAD_ROW = 64 leaves room for the W <= 63
+# maximum tail.  With this plane resident the kernel resolves 128-lane
+# seams entirely in VMEM — the XLA seam fix-up pass (and its per-chunk
+# re-read of seam bytes from HBM) disappears from the fused map path.
+AUX_ROWS = 96
+AUX_HEAD_ROW = 64
+
 # Analyzer contract (costcheck vmem/race passes): compact mode emits a
 # spill counter (output #6) whose nonzero value means the planes are
 # INCOMPLETE — the caller MUST wrap a full-resolution fallback in lax.cond
@@ -71,13 +82,21 @@ meta.register(meta.KernelMeta(
 
 def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
               compact_slots: int = 0, w: int = DEFAULT_MAX_TOKEN,
-              lane_major: bool = False) -> meta.VmemPlan:
+              lane_major: bool = False, fused: bool = False) -> meta.VmemPlan:
     """Static VMEM/SMEM footprint of one tokenize-kernel geometry, from
     the same BlockSpec/scratch arithmetic :func:`_column_pass` binds —
-    the analyzer's metadata hook (ops/pallas/meta.py)."""
+    the analyzer's metadata hook (ops/pallas/meta.py).  ``fused`` adds the
+    seam-carry aux plane and the in-VMEM transposed byte block of the
+    fused map path."""
     out_rows = compact_slots if compact_slots else block_rows // 2
     n_scalars = 3 if compact_slots else 2
     bufs = [meta.Buffer("bytes-in", "vmem", block_rows * LANES, True)]
+    if fused:
+        bufs.append(meta.Buffer("seam-aux", "vmem", AUX_ROWS * LANES, True))
+        # The raw lane-view block is transposed (widened) in VMEM before
+        # the lookback loop; charge the int32 copy as resident scratch.
+        bufs.append(meta.Buffer("transpose-scratch", "vmem",
+                                block_rows * LANES * 4, False))
     bufs += [meta.Buffer(f"plane-out[{i}]", "vmem", out_rows * LANES * 4,
                          True) for i in range(3)]
     bufs += [meta.Buffer(f"scalar[{i}]", "smem", 4, False)
@@ -85,7 +104,8 @@ def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
     bufs.append(meta.Buffer("carry-scratch", "vmem", (w + 1) * LANES * 4,
                             False))
     geom = (f"block_rows={block_rows} w={w} slots={compact_slots or 'pair'}"
-            + (" lane-major" if lane_major else ""))
+            + (" lane-major" if lane_major else "")
+            + (" fused" if fused else ""))
     return meta.VmemPlan(
         kernel="_tokenize_kernel", geometry=geom, buffers=tuple(bufs),
         vmem_limit_bytes=64 * 1024 * 1024 if compact_slots else None)
@@ -198,9 +218,9 @@ def _compact_planes(khi, klo, packed, has, slots: int):
     return out[0], out[1], out[2], n_spilled
 
 
-def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
-                     *refs, w: int, block_rows: int, data_rows: int,
-                     compact_slots: int = 0, lane_major: bool = False):
+def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
+                     compact_slots: int = 0, lane_major: bool = False,
+                     fused: bool = False):
     """One grid step: emit pair-compacted (key_hi, key_lo, packed) planes.
 
     Logical output row t of block i describes byte-row ``m = i*block_rows +
@@ -218,39 +238,74 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
     non-emitting pairs carry the sentinel key and all-ones packed.  ``ntok``
     accumulates the total emission count so callers get exact totals without
     another stream-sized pass.
+
+    ``fused`` is the fully-fused map path (ISSUE 6): the byte input is the
+    RAW ``(LANES, block_rows)`` lane view (transposed to the column layout
+    in VMEM — the sublane-shift lookback structure is kept, the XLA-side
+    transpose+pad materialization is not), and a second ``(AUX_ROWS,
+    LANES)`` seam-carry input resolves 128-lane seams in-kernel: the i==0
+    carry holds the PREVIOUS lane's tail instead of artificial separators,
+    and the last data row's next-byte test reads the NEXT lane's first
+    byte.  No token is deferred — the XLA seam fix-up pass (and its HBM
+    round-trip over seam windows) does not exist on this path.
     """
-    # Positional refs after the three planes + two scalars: the optional
-    # spill scalar (compact mode only), then the carry scratch.
+    # Positional refs: the optional seam-carry aux input (fused mode),
+    # the three planes + two scalars, then the optional spill scalar
+    # (compact mode only) and the carry scratch.
+    if fused:
+        aux_ref, refs = refs[0], refs[1:]
+    else:
+        aux_ref = None
+    khi_ref, klo_ref, packed_ref, over_ref, ntok_ref = refs[:5]
+    refs = refs[5:]
     if compact_slots:
         spill_ref, carry_ref = refs
     else:
         spill_ref, (carry_ref,) = None, refs
     i = pl.program_id(0)
     tb = block_rows
+    aux = aux_ref[:].astype(jnp.int32) if fused else None
 
     @pl.when(i == 0)
     def _():
-        # Rows "above" the first block are artificial separators: every lane
-        # top is a segment start (real continuation is the previous lane's
-        # tail, which the seam pass owns).
-        carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
+        if fused:
+            # The carry above each lane's first block is the PREVIOUS
+            # lane's last w+1 bytes (PAD for lane 0): the lookback crosses
+            # lane seams over real bytes, in VMEM.
+            carry_ref[:] = aux[: w + 1, :]
+        else:
+            # Rows "above" the first block are artificial separators: every
+            # lane top is a segment start (real continuation is the previous
+            # lane's tail, which the seam pass owns).
+            carry_ref[:] = jnp.full_like(carry_ref, constants.PAD_BYTE)
         over_ref[0, 0] = jnp.uint32(0)
         ntok_ref[0, 0] = jnp.uint32(0)
         if spill_ref is not None:
             spill_ref[0, 0] = jnp.uint32(0)
 
     # Widen bytes to int32 immediately: v5e Mosaic has no 8-bit vector
-    # compares, and 32-bit lanes are the VPU-native layout anyway.
-    x = x_ref[:].astype(jnp.int32)
+    # compares, and 32-bit lanes are the VPU-native layout anyway.  The
+    # fused path's raw lane-view block transposes to the same column
+    # layout here (a VMEM-local move) so the whole lookback below is
+    # shared verbatim between the paths.
+    x = x_ref[:].astype(jnp.int32).T if fused else x_ref[:].astype(jnp.int32)
     ext = jnp.concatenate([carry_ref[:], x], axis=0)  # (w+1+tb, LANES) int32
     carry_ref[:] = x[tb - (w + 1):, :]
 
     sep = _sep_mask_i32(ext)
     c = (ext + 1).astype(jnp.uint32)
 
+    row_in_block = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 0)
+    m = i * tb + row_in_block - 1  # byte row within the lane's segment
+
     # Positions handled this step: ext rows [w, w+tb) = byte rows m below.
     cur_sep = sep[w:w + tb]
     nxt_sep = sep[w + 1:w + tb + 1]
+    if fused:
+        # The lane's LAST data byte's successor is the next lane's first
+        # byte (aux head row), not the pad row the column view shows.
+        nh_sep = _sep_mask_i32(aux[AUX_HEAD_ROW:AUX_HEAD_ROW + 1, :])
+        nxt_sep = jnp.where(m == data_rows - 1, nh_sep, nxt_sep)
     is_end = (~cur_sep) & nxt_sep
 
     intok = ~cur_sep
@@ -267,21 +322,30 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
     # True length may exceed w: the byte w back is still inside the run.
     run_exceeds_w = intok & ~sep[0:tb]
 
-    row_in_block = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 0)
-    m = i * tb + row_in_block - 1  # byte row within the lane's segment
+    if fused:
+        # No deferral: the seam-carry aux made every lookback and every
+        # next-byte test exact across lane seams.  Only the phantom m=-1
+        # row (block 0's one-row output trail — the previous lane's last
+        # byte, owned by THAT lane's last data row) is masked.
+        alive = m >= 0
+        emit = is_end & ~run_exceeds_w & alive
+        overlong_here = is_end & run_exceeds_w & alive
+    else:
+        # Defer to the seam pass: tokens starting at lane row 0 (previous
+        # byte is another lane's data) and tokens ending at the lane's last
+        # data row (next byte is another lane's data, so is_end itself is
+        # unreliable there).
+        starts_at_lane_top = ln.astype(jnp.int32) == m + 1
+        ends_at_lane_bottom = m == data_rows - 1
+        emit = is_end & ~run_exceeds_w & ~starts_at_lane_top \
+            & ~ends_at_lane_bottom
 
-    # Defer to the seam pass: tokens starting at lane row 0 (previous byte is
-    # another lane's data) and tokens ending at the lane's last data row (next
-    # byte is another lane's data, so is_end itself is unreliable there).
-    starts_at_lane_top = ln.astype(jnp.int32) == m + 1
-    ends_at_lane_bottom = m == data_rows - 1
-    emit = is_end & ~run_exceeds_w & ~starts_at_lane_top & ~ends_at_lane_bottom
-
-    # Overlong runs are counted exactly once, at their true end.  Runs whose
-    # lookback crosses the lane top are counted by the seam pass instead
-    # (their suppression here shows up as starts_at_lane_top=False only when
-    # the lookback window is fully in-lane, which run_exceeds_w guarantees).
-    overlong_here = is_end & run_exceeds_w & ~ends_at_lane_bottom
+        # Overlong runs are counted exactly once, at their true end.  Runs
+        # whose lookback crosses the lane top are counted by the seam pass
+        # instead (their suppression here shows up as starts_at_lane_top=
+        # False only when the lookback window is fully in-lane, which
+        # run_exceeds_w guarantees).
+        overlong_here = is_end & run_exceeds_w & ~ends_at_lane_bottom
     # Mosaic cannot lower reductions over unsigned ints; sum in int32.
     n_overlong = jnp.sum(overlong_here.astype(jnp.int32)).astype(jnp.uint32)
     over_ref[0, 0] = over_ref[0, 0] + n_overlong
@@ -364,7 +428,7 @@ def _tokenize_kernel(x_ref, khi_ref, klo_ref, packed_ref, over_ref, ntok_ref,
 
 def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
                  data_rows: int, interpret: bool, compact_slots: int = 0,
-                 lane_major: bool = False):
+                 lane_major: bool = False, fused_aux: jax.Array | None = None):
     """Run the kernel over the (rows, 128) column view (one trailing pad block).
 
     Returns pair-compacted planes of rows//2 output rows — or, with
@@ -374,12 +438,18 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
     scalars (spill is 0 on the pair path).  With ``lane_major`` (compact
     mode only) the planes are (LANES, grid*S) transposed blocks whose
     row-major flattening is global byte-position order.
+
+    With ``fused_aux`` (the :func:`_seam_aux` seam-carry plane) the input
+    is instead the RAW ``(LANES, rows)`` lane view — no XLA-side transpose
+    — and the kernel runs the fused map path (in-kernel seams, no token
+    deferred; see ``_tokenize_kernel``).
     """
-    rows = cols_padded.shape[0]
+    fused = fused_aux is not None
+    rows = cols_padded.shape[1] if fused else cols_padded.shape[0]
     grid = rows // block_rows
     kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
                              data_rows=data_rows, compact_slots=compact_slots,
-                             lane_major=lane_major)
+                             lane_major=lane_major, fused=fused)
     out_rows = grid * compact_slots if compact_slots else rows // 2
     block_out = compact_slots if compact_slots else block_rows // 2
     if lane_major:
@@ -405,11 +475,20 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         or pltpu.TPUCompilerParams
     params = _params_cls(vmem_limit_bytes=64 * 1024 * 1024) \
         if compact_slots else None
+    if fused:
+        in_specs = [pl.BlockSpec((LANES, block_rows), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((AUX_ROWS, LANES), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM)]
+        args = (cols_padded, fused_aux)
+    else:
+        in_specs = [pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)]
+        args = (cols_padded,)
     outs = pl.pallas_call(
         kern,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)],
+        in_specs=in_specs,
         out_shape=[out32, out32, out32] + [scalar] * n_scalars,
         out_specs=[plane_spec] * 3
         + [pl.BlockSpec((1, 1), lambda i: (0, 0),
@@ -417,7 +496,7 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
         compiler_params=params,
         interpret=interpret,
-    )(cols_padded)
+    )(*args)
     khi, klo, packed, over, ntok = outs[:5]
     spill = outs[5][0, 0] if compact_slots else jnp.uint32(0)
     return khi, klo, packed, over[0, 0], ntok[0, 0], spill
@@ -557,9 +636,10 @@ def tokenize_split_compact(data: jax.Array, compact_slots: int,
                                 lane_major)
 
 
-def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
-                         interpret, compact_slots: int,
-                         lane_major: bool = False):
+def _resolve_args(data, max_token_bytes, block_rows, interpret,
+                  compact_slots: int):
+    """Shared argument validation/resolution for the split and fused entry
+    points: returns ``(w, seg_len, block_rows, interpret)``."""
     if interpret is None:
         # Mosaic only targets TPU; elsewhere (CPU tests, debugging) the
         # interpreter executes the same kernel semantics.
@@ -601,23 +681,18 @@ def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
             f"input of {n} bytes gives lane segments of {seg_len} < 2W+2="
             f"{2 * w + 2} bytes; seam windows would overlap (grow the chunk "
             f"or shrink max_token_bytes)")
+    return w, seg_len, block_rows, interpret
 
-    # Column-major view + pad rows to a whole number of blocks, plus one extra
-    # pad block so every data row gets an output (outputs trail by one row).
-    cols = data.reshape(LANES, seg_len).T
-    pad_rows = (-seg_len) % block_rows + block_rows
-    cols_padded = jnp.concatenate(
-        [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
 
-    khi, klo, packed, over_cols, n_tokens, spill = _column_pass(
-        cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
-        compact_slots=compact_slots, lane_major=lane_major)
+def _packed_stream(khi, klo, packed, total, base_offset) -> PackedTokenStream:
+    """Flatten kernel planes into the :class:`PackedTokenStream` view.
 
-    # The kernel already pair-compacted and packed (start << 6 | len) in
-    # VMEM (see _tokenize_kernel); reconstruct the TokenStream view lazily —
-    # pos/length/count are elementwise functions of `packed`, which XLA
-    # fuses into whatever consumes them (aggregation feeds `packed` straight
-    # into its sort, so the reconstructed planes never hit HBM there).
+    The kernel already compacted and packed (start << 6 | len) in VMEM;
+    pos/length/count are elementwise functions of ``packed``, which XLA
+    fuses into whatever consumes them (aggregation feeds ``packed``
+    straight into its sort, so the reconstructed planes never hit HBM
+    there).
+    """
     khi = khi.reshape(-1)
     klo = klo.reshape(-1)
     packed = packed.reshape(-1)
@@ -631,15 +706,95 @@ def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
                       (packed >> 6) + jnp.asarray(base_offset, jnp.uint32),
                       jnp.uint32(constants.POS_INF))
     base_is_zero = isinstance(base_offset, int) and base_offset == 0
-    col_stream = PackedTokenStream(
+    return PackedTokenStream(
         key_hi=khi, key_lo=klo,
         count=has_tok.astype(jnp.uint32),
         pos=start, length=ln,
         packed=packed if base_is_zero else None,
-        total=n_tokens)
+        total=total)
 
+
+def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
+                         interpret, compact_slots: int,
+                         lane_major: bool = False):
+    w, seg_len, block_rows, interpret = _resolve_args(
+        data, max_token_bytes, block_rows, interpret, compact_slots)
+
+    # Column-major view + pad rows to a whole number of blocks, plus one extra
+    # pad block so every data row gets an output (outputs trail by one row).
+    cols = data.reshape(LANES, seg_len).T
+    pad_rows = (-seg_len) % block_rows + block_rows
+    cols_padded = jnp.concatenate(
+        [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
+
+    khi, klo, packed, over_cols, n_tokens, spill = _column_pass(
+        cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
+        compact_slots=compact_slots, lane_major=lane_major)
+
+    col_stream = _packed_stream(khi, klo, packed, n_tokens, base_offset)
     seam_stream, over_seams = _seam_pass(data, seg_len, w, base_offset)
     return col_stream, seam_stream, over_cols + over_seams, spill
+
+
+def _seam_aux(view: jax.Array, w: int) -> jax.Array:
+    """Build the fused kernel's ``(AUX_ROWS, LANES)`` seam-carry plane from
+    the raw ``(LANES, seg_len)`` lane view: rows ``[0, w+1)`` hold byte
+    ``lane*L - (w+1) + c`` (the previous lane's tail; PAD for lane 0) and
+    rows ``[AUX_HEAD_ROW, AUX_ROWS)`` the next lane's first byte (PAD for
+    lane 127).  ~12 KB of static slices — noise next to the chunk."""
+    seg_len = view.shape[1]
+    pad = constants.PAD_BYTE
+    tails = jnp.concatenate(
+        [jnp.full((1, w + 1), pad, jnp.uint8),
+         view[:-1, seg_len - (w + 1):]], axis=0)  # (LANES, w+1)
+    heads = jnp.concatenate(
+        [view[1:, :1], jnp.full((1, 1), pad, jnp.uint8)], axis=0)
+    mid = jnp.full((LANES, AUX_HEAD_ROW - (w + 1)), pad, jnp.uint8)
+    rep = jnp.broadcast_to(heads, (LANES, AUX_ROWS - AUX_HEAD_ROW))
+    return jnp.concatenate([tails, mid, rep], axis=1).T
+
+
+def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
+                   base_offset: jax.Array | int = 0,
+                   max_token_bytes: int = DEFAULT_MAX_TOKEN,
+                   block_rows: int | None = None,
+                   interpret: bool | None = None,
+                   lane_major: bool = False
+                   ) -> tuple[PackedTokenStream, jax.Array, jax.Array]:
+    """Fully fused map path (ISSUE 6): ``(stream, overlong, spill)`` from
+    ONE kernel pass over the raw chunk bytes — no XLA transpose/pad of the
+    input, no seam fix-up pass, no separate seam stream.
+
+    Emission-set parity with :func:`tokenize_split` is exact: the same
+    tokens (<= ``max_token_bytes`` bytes, counted once each), the same
+    overlong accounting, and the same poison rows at overlong ends — but
+    cross-lane-seam tokens are hashed in-kernel from the seam-carry aux
+    plane (:func:`_seam_aux`) instead of being deferred to the XLA scan
+    over 129 seam windows, so aggregation consumes a single stream.  With
+    ``lane_major`` the flattened stream remains in global byte-position
+    order (cross-seam tokens land in their end lane's first window, which
+    is exactly their start-position slot), preserving the stable2
+    aggregation precondition.
+
+    ``spill`` semantics match :func:`tokenize_split_compact`: nonzero
+    means the compact planes are incomplete and the caller MUST fall back
+    to an exact path under ``lax.cond`` (the fused fallback is this same
+    kernel in pair mode — ``compact_slots=0``).
+    """
+    w, seg_len, block_rows, interpret = _resolve_args(
+        data, max_token_bytes, block_rows, interpret, compact_slots)
+    view = data.reshape(LANES, seg_len)
+    # Pad lane columns to a whole number of blocks plus one extra pad block
+    # (outputs trail by one row, exactly like the split column view).
+    pad_cols = (-seg_len) % block_rows + block_rows
+    view_padded = jnp.pad(view, ((0, 0), (0, pad_cols)),
+                          constant_values=constants.PAD_BYTE)
+    khi, klo, packed, overlong, n_tokens, spill = _column_pass(
+        view_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
+        compact_slots=compact_slots, lane_major=lane_major,
+        fused_aux=_seam_aux(view, w))
+    return _packed_stream(khi, klo, packed, n_tokens, base_offset), \
+        overlong, spill
 
 
 def concat_streams(col: PackedTokenStream, seam: TokenStream) -> PackedTokenStream:

@@ -816,6 +816,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      devices=n_dev, chunk_bytes=config.chunk_bytes,
                      superstep=config.superstep,
                      backend=config.resolved_backend(),
+                     map_impl=config.map_impl,
                      merge_strategy=merge_strategy, input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      retry=retry)
@@ -984,6 +985,7 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                          chunk_bytes=config.chunk_bytes,
                          superstep=config.superstep,
                          backend=config.resolved_backend(),
+                         map_impl=config.map_impl,
                          merge_strategy=merge_strategy,
                          input=_path_names(path),
                          resume_step=start_step, resume_offset=start_offset)

@@ -101,6 +101,18 @@ def test_ab_knob_write_refused_with_stderr_trace(last_good, capsys,
     assert "refused" in err and "BENCH_SORT_IMPL" in err
 
 
+def test_map_impl_knob_write_refused_with_stderr_trace(last_good, capsys,
+                                                       monkeypatch):
+    """BENCH_MAP_IMPL (the ISSUE 6 fused-map A/B knob) is measurement-
+    altering: the class-based refusal must cover it without it ever being
+    listed anywhere — the 'future knob refused by default' guarantee."""
+    monkeypatch.setenv("BENCH_MAP_IMPL", "fused")
+    bench._write_last_good(R5_GOOD)
+    assert not last_good.exists()
+    err = capsys.readouterr().err
+    assert "refused" in err and "BENCH_MAP_IMPL" in err
+
+
 def test_probe_knobs_are_headline_safe(last_good, monkeypatch):
     """BENCH_RETRY_BUDGET_S / BENCH_PROBE_TIMEOUT_S shape pre-measurement
     reachability retries only (measurement-neutral, ADVICE r5): a run

@@ -281,3 +281,281 @@ def test_checked_in_baselines_cover_all_models():
         base = load_baseline(name)
         assert base is not None, f"missing analysis/baselines/{name}.json"
         assert base["effective_input_passes"] > 0
+
+
+# -- fusion-opportunity pass (ISSUE 6) ---------------------------------------
+
+
+class SmallAdjacentJob(_ScalarJob):
+    """Two adjacent materializing eqns with a VMEM-sized intermediate —
+    cumsum feeds (through a fusible +1) a sort: the canonical candidate
+    the fusion-opportunity pass exists to surface."""
+
+    def map_chunk(self, chunk, chunk_id):
+        x = chunk[:128].astype(jnp.uint32)
+        y = jnp.cumsum(x)
+        z = jnp.sort(y + 1)
+        return z[0]
+
+
+class HugeAdjacentJob(_ScalarJob):
+    """The same adjacency shape, but the pair's combined working set
+    (two 64 MiB f32 planes in flight) dwarfs Mosaic's 16 MB VMEM
+    envelope: NOT a candidate — flagging it would send someone chasing a
+    fusion that cannot be a kernel (the known-bad fixture of the pass)."""
+
+    def map_chunk(self, chunk, chunk_id):
+        big = jnp.zeros((4096, 4096), jnp.float32) + chunk[0]
+        y = jnp.cumsum(big, axis=0)
+        z = jnp.sort(y, axis=0)
+        return z[0, 0].astype(jnp.uint32)
+
+
+def _fusion_candidates(report, model):
+    art = report.artifacts[model]["fusion"]
+    return [c for prog in art["programs"].values() for c in prog]
+
+
+@pytest.mark.smoke
+def test_fusion_pass_flags_adjacent_pair(mesh8):
+    from mapreduce_tpu.analysis.passes.fusion import FusionPass
+
+    report = analysis.analyze_job(SmallAdjacentJob(), "small-adjacent",
+                                  mesh=mesh8, passes=[FusionPass()])
+    assert not report.errors, report.format_text()  # candidates are leads
+    cands = _fusion_candidates(report, "small-adjacent")
+    pair = [c for c in cands
+            if c["producer"] == "cumsum" and c["consumer"] == "sort"]
+    assert pair, cands
+    assert pair[0]["hbm_bytes_saved"] == 2 * pair[0]["intermediate_bytes"]
+    assert pair[0]["combined_vmem_bytes"] <= meta.VMEM_DEFAULT_LIMIT
+    assert any("candidate fusion" in f.message for f in report.findings)
+
+
+def test_fusion_pass_respects_vmem_envelope(mesh8):
+    """Adjacent materializing eqns whose combined footprint exceeds the
+    VMEM envelope must NOT be flagged."""
+    from mapreduce_tpu.analysis.passes.fusion import FusionPass
+
+    report = analysis.analyze_job(HugeAdjacentJob(), "huge-adjacent",
+                                  mesh=mesh8, passes=[FusionPass()])
+    assert not report.errors, report.format_text()
+    cands = _fusion_candidates(report, "huge-adjacent")
+    assert not [c for c in cands
+                if c["producer"] == "cumsum" and c["consumer"] == "sort"], \
+        cands
+    # The envelope invariant holds for every candidate the pass emits.
+    assert all(c["combined_vmem_bytes"] <= meta.VMEM_DEFAULT_LIMIT
+               for c in cands), cands
+
+
+# -- fused-vs-split cost gate (ISSUE 6) --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fused_ctx(mesh8):
+    job = models_mod.build_model("wordcount_fused")
+    return acore.AnalysisContext(job, "wordcount_fused", mesh=mesh8)
+
+
+@pytest.mark.smoke
+def test_cost_gate_certifies_fused_below_split(fused_ctx):
+    """The machine-checked before/after: the fused model prices strictly
+    below the split-path baseline, and the artifact carries the gap."""
+    report = acore.run_pipeline(fused_ctx, [CostPass()])
+    assert not report.errors, report.format_text()
+    art = report.artifacts["wordcount_fused"]["cost"]
+    gap = art["fused_vs_split"]
+    assert gap["split_model"] == "wordcount_pallas"
+    assert gap["fused_effective_input_passes"] \
+        < gap["split_effective_input_passes"]
+    assert gap["passes_saved"] > 0
+    assert any("fusion certified" in f.message for f in report.findings)
+
+
+def test_cost_gate_flags_fusion_that_stopped_winning(mesh8, tmp_path,
+                                                     fused_ctx):
+    """A split baseline priced BELOW the fused program = the fusion
+    stopped deleting traffic: ERROR."""
+    if "cost" not in fused_ctx.artifacts:
+        acore.run_pipeline(fused_ctx, [CostPass()])
+    fused_passes = fused_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = fused_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_pallas.json").write_text(json.dumps(
+        {"model": "wordcount_pallas",
+         "effective_input_passes": fused_passes / 2,
+         "traced_chunk_bytes": chunk}))
+    # The fused model's own baseline must still gate clean from tmp_path.
+    (tmp_path / "wordcount_fused.json").write_text(json.dumps(
+        {"model": "wordcount_fused",
+         "effective_input_passes": fused_passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(fused_ctx.job, "wordcount_fused",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = fused_ctx.engine_traces  # reuse the trace
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("NOT strictly below" in f.message for f in errs), \
+        report.format_text()
+    assert report.exit_code != 0
+
+
+def test_cost_gate_refuses_incomparable_chunk_geometry(mesh8, tmp_path,
+                                                       fused_ctx):
+    """A split baseline priced at a DIFFERENT chunk geometry cannot gate
+    the fused model: passes are per-chunk, comparing them is nonsense."""
+    if "cost" not in fused_ctx.artifacts:
+        acore.run_pipeline(fused_ctx, [CostPass()])
+    fused_passes = fused_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = fused_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_pallas.json").write_text(json.dumps(
+        {"model": "wordcount_pallas",
+         "effective_input_passes": fused_passes * 2,
+         "traced_chunk_bytes": chunk * 2}))
+    (tmp_path / "wordcount_fused.json").write_text(json.dumps(
+        {"model": "wordcount_fused",
+         "effective_input_passes": fused_passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(fused_ctx.job, "wordcount_fused",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = fused_ctx.engine_traces
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("not" in f.message and "comparable" in f.message
+               for f in errs), report.format_text()
+    # The rejected gap must NOT be published: bench._cost_record copies
+    # the artifact verbatim into BENCH JSON.
+    assert "fused_vs_split" not in report.artifacts["wordcount_fused"]["cost"]
+
+
+def test_cost_gate_flags_malformed_split_baseline(mesh8, tmp_path,
+                                                  fused_ctx):
+    """A split baseline with a zero/missing effective_input_passes AND a
+    different chunk geometry must name the broken BASELINE — not publish
+    a nonsense gap, and not misdiagnose as 'the fusion stopped deleting
+    traffic' (the old `split_ref > 0` guard skipped the geometry check
+    on exactly this input)."""
+    if "cost" not in fused_ctx.artifacts:
+        acore.run_pipeline(fused_ctx, [CostPass()])
+    fused_passes = fused_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = fused_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_pallas.json").write_text(json.dumps(
+        {"model": "wordcount_pallas",
+         "effective_input_passes": 0.0,
+         "traced_chunk_bytes": chunk * 2}))
+    (tmp_path / "wordcount_fused.json").write_text(json.dumps(
+        {"model": "wordcount_fused",
+         "effective_input_passes": fused_passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(fused_ctx.job, "wordcount_fused",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = fused_ctx.engine_traces
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("no usable effective_input_passes" in f.message
+               for f in errs), report.format_text()
+    assert not any("NOT strictly below" in f.message for f in errs)
+    assert "fused_vs_split" not in report.artifacts["wordcount_fused"]["cost"]
+
+
+def test_cost_gate_refuses_baseline_missing_geometry(mesh8, tmp_path,
+                                                     fused_ctx):
+    """A split baseline that never recorded traced_chunk_bytes cannot be
+    certified geometry-comparable: missing must gate like mismatched,
+    not wildcard-match and publish the gap."""
+    if "cost" not in fused_ctx.artifacts:
+        acore.run_pipeline(fused_ctx, [CostPass()])
+    fused_passes = fused_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = fused_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_pallas.json").write_text(json.dumps(
+        {"model": "wordcount_pallas",
+         "effective_input_passes": fused_passes * 2}))
+    (tmp_path / "wordcount_fused.json").write_text(json.dumps(
+        {"model": "wordcount_fused",
+         "effective_input_passes": fused_passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(fused_ctx.job, "wordcount_fused",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = fused_ctx.engine_traces
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("not comparable" in f.message for f in errs), \
+        report.format_text()
+    assert "fused_vs_split" not in report.artifacts["wordcount_fused"]["cost"]
+
+
+class SharedJaxprJob(_ScalarJob):
+    """Two same-shaped jnp.sort calls: JAX's pjit cache hands both the
+    SAME inner jaxpr (and Var objects).  A later cumsum consumes the
+    FIRST sort's result — NOT adjacent (the second sort sits between) —
+    so no sort->cumsum candidate may appear.  Guards the value-id
+    canonicalization in _scan_scope: keying on shared Vars would alias
+    the two calls' results and fabricate exactly that candidate."""
+
+    def map_chunk(self, chunk, chunk_id):
+        x = chunk[:128].astype(jnp.uint32)
+        a = jnp.sort(x)
+        b = jnp.sort(x + 2)
+        z = jnp.cumsum(a)
+        return z[0] + b[0]
+
+
+def test_fusion_pass_does_not_alias_cached_jaxpr_calls(mesh8):
+    from mapreduce_tpu.analysis.passes.fusion import FusionPass
+
+    report = analysis.analyze_job(SharedJaxprJob(), "shared-jaxpr",
+                                  mesh=mesh8, passes=[FusionPass()])
+    cands = _fusion_candidates(report, "shared-jaxpr")
+    assert not [c for c in cands
+                if c["producer"] == "sort" and c["consumer"] == "cumsum"], \
+        cands
+
+
+class DowncastChainJob(_ScalarJob):
+    """cumsum(uint32) -> astype(uint8) -> sort: the value round-tripping
+    HBM is cumsum's 4-byte-per-element OUTPUT, not the 1-byte derived
+    operand the sort consumes — pricing the consumer-side aval would
+    report the saved traffic 4x too small."""
+
+    def map_chunk(self, chunk, chunk_id):
+        x = chunk[:128].astype(jnp.uint32)
+        y = jnp.cumsum(x)
+        z = jnp.sort((y & 0xFF).astype(jnp.uint8))
+        return z[0].astype(jnp.uint32)
+
+
+def test_fusion_pass_prices_materialized_producer_output(mesh8):
+    from mapreduce_tpu.analysis.passes.fusion import FusionPass
+
+    report = analysis.analyze_job(DowncastChainJob(), "downcast-chain",
+                                  mesh=mesh8, passes=[FusionPass()])
+    pair = [c for c in _fusion_candidates(report, "downcast-chain")
+            if c["producer"] == "cumsum" and c["consumer"] == "sort"]
+    assert pair, _fusion_candidates(report, "downcast-chain")
+    # 128 x uint32 = 512 bytes materialized (NOT 128 x uint8 = 128).
+    assert pair[0]["intermediate_bytes"] == 512, pair
+
+
+class FanoutIntermediateJob(_ScalarJob):
+    """cumsum feeds the adjacent sort AND a later equation: the fused
+    kernel deletes the sort's READ of the intermediate, but its WRITE
+    must stay for the other consumer — crediting 2x here would inflate
+    the candidate over genuinely single-consumer fusions."""
+
+    def map_chunk(self, chunk, chunk_id):
+        x = chunk[:128].astype(jnp.uint32)
+        y = jnp.cumsum(x)
+        z = jnp.sort(y)
+        return z[0] + y[0]  # y escapes the chain
+
+
+def test_fusion_pass_keeps_write_for_fanout_intermediate(mesh8):
+    from mapreduce_tpu.analysis.passes.fusion import FusionPass
+
+    report = analysis.analyze_job(FanoutIntermediateJob(), "fanout-inter",
+                                  mesh=mesh8, passes=[FusionPass()])
+    pair = [c for c in _fusion_candidates(report, "fanout-inter")
+            if c["producer"] == "cumsum" and c["consumer"] == "sort"]
+    assert pair, _fusion_candidates(report, "fanout-inter")
+    # Read saved, write preserved: 1x the intermediate, not 2x.
+    assert pair[0]["hbm_bytes_saved"] == pair[0]["intermediate_bytes"], pair

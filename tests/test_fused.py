@@ -1,0 +1,245 @@
+"""Config.map_impl='fused': the one-kernel map path (ISSUE 6).
+
+The fused kernel consumes RAW chunk bytes and emits hashed, window-sorted
+rows in a single ``pallas_call`` — tokenize -> hash -> window compaction
+in VMEM, lane seams resolved in-kernel from the seam-carry plane, no
+token-plane round-trip to HBM before the aggregation sort.
+
+Contract under test: fused is BIT-IDENTICAL to the split path (compact
+kernel + XLA seam fix-up) on every corpus shape — tokens, counts, first
+occurrences, dropped accounting, overlong rescue, spill fallback, n-gram
+formation — and the lane-major fused stream preserves the stable2
+position-order precondition without a seam concat.
+"""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models import wordcount
+from mapreduce_tpu.ops import tokenize as tok
+from mapreduce_tpu.ops.pallas import tokenize as ptok
+from mapreduce_tpu.utils import oracle
+from tests.conftest import make_corpus
+
+W = 8  # small lookback: overlong/seam paths exercised cheaply
+CAP = 4096
+
+
+def _interpret():
+    from tests.conftest import pallas_interpret_mode
+
+    return pallas_interpret_mode()
+
+
+def _pad(data: bytes, w: int = W) -> np.ndarray:
+    n = max(128 * (2 * w + 2), -(-len(data) // 128) * 128)
+    return tok.pad_to(data, n)
+
+
+def _cfg(map_impl: str, **kw) -> Config:
+    kw.setdefault("chunk_bytes", 128 * (2 * 32 + 2))
+    kw.setdefault("table_capacity", CAP)
+    return Config(backend="pallas", map_impl=map_impl, **kw)
+
+
+def _assert_results_equal(a, b):
+    assert a.words == b.words
+    assert a.counts == b.counts
+    assert a.total == b.total
+    assert a.dropped_count == b.dropped_count
+
+
+# -- kernel-level: the fused stream vs split col+seam ------------------------
+
+
+@pytest.mark.smoke
+def test_fused_stream_matches_split_row_set(rng):
+    """The fused kernel's ONE stream holds exactly the split path's column
+    rows PLUS its seam rows: same live (key, packed) multiset, same exact
+    total — the no-deferral property that deletes the seam fix-up pass."""
+    corpus = make_corpus(rng, n_words=3000, vocab=200)
+    buf = _pad(corpus)
+    col, seam, over_s = ptok.tokenize_split(buf, max_token_bytes=W,
+                                            interpret=True)
+    fused, over_f, spill = ptok.tokenize_fused(buf, max_token_bytes=W,
+                                               interpret=True)
+
+    def rows(key_hi, key_lo, packed, live):
+        k = np.stack([np.asarray(key_hi), np.asarray(key_lo),
+                      np.asarray(packed)], axis=1)[live]
+        return k[np.lexsort(k.T)]
+
+    # Seam rows are a TokenStream: dead rows carry pos=POS_INF/count=0
+    # (NOT the packed sentinel), so liveness comes from `count`, and the
+    # packed view is rebuilt in uint64 before the uint32 cut.
+    seam_packed = ((np.asarray(seam.pos).astype(np.uint64) << 6)
+                   | np.asarray(seam.length)).astype(np.uint32)
+    n_seam = int((np.asarray(seam.count) != 0).sum())
+    split_rows = np.concatenate([
+        rows(col.key_hi, col.key_lo, col.packed,
+             np.asarray(col.packed) != 0xFFFFFFFF),
+        rows(seam.key_hi, seam.key_lo, seam_packed,
+             np.asarray(seam.count) != 0)])
+    split_rows = split_rows[np.lexsort(split_rows.T)]
+    np.testing.assert_array_equal(
+        rows(fused.key_hi, fused.key_lo, fused.packed,
+             np.asarray(fused.packed) != 0xFFFFFFFF), split_rows)
+    assert int(fused.total) == int(col.total) + n_seam
+    assert int(over_f) == int(over_s)
+    assert int(spill) == 0
+
+
+def test_fused_lane_major_stream_is_position_ordered(rng):
+    """The stable2 precondition holds WITHOUT a seam concat: the fused
+    lane-major stream's live rows (cross-seam emissions included) carry
+    strictly increasing positions."""
+    corpus = make_corpus(rng, n_words=4000, vocab=300)
+    buf = _pad(corpus)
+    stream, _over, spill = ptok.tokenize_fused(
+        buf, compact_slots=128, max_token_bytes=W, block_rows=384,
+        lane_major=True, interpret=True)
+    packed = np.asarray(stream.packed)
+    live = packed != 0xFFFFFFFF
+    pos = (packed[live] >> 6).astype(np.int64)
+    assert len(pos) > 100
+    assert np.all(np.diff(pos) > 0)
+    assert int(spill) == 0
+
+
+# -- model-level bit-identity ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fused_wordcount_bit_identical(rng):
+    """Whole wordcount pipeline (compact stable2 default) fused vs split,
+    plus the XLA oracle.
+
+    @slow (round 9): 58 s measured — two full compact-pipeline compiles
+    on this 1-core box, 6x past the PR-1 ">= ~10 s carries slow" line.
+    Tier-1 keeps fused wordcount covered end-to-end by the oracle-exact
+    rescue+spill test below (one compile, both cond branches executed)
+    and split-vs-fused identity at the stream level by the row-set test
+    above; this full split-parity twin runs in the pre-release suite."""
+    corpus = make_corpus(rng, n_words=1500, vocab=150)
+    with _interpret():
+        a = wordcount.count_words(corpus, _cfg("split"))
+        b = wordcount.count_words(corpus, _cfg("fused"))
+    _assert_results_equal(a, b)
+    assert a.as_dict() == oracle.word_counts(corpus)
+
+
+@pytest.mark.smoke
+def test_fused_rescue_and_spill_oracle_exact():
+    """BOTH fused fallback semantics in one compile (tier-1's cheap
+    coverage; the two-compile split-parity twins below are @slow per the
+    PR-1 ">= ~10 s carries slow" line): chunk 1 is slot-budget-dense and
+    must take the spill fallback (the SAME fused kernel in pair mode),
+    chunk 2 is sparse with overlong runs — one crossing a lane seam —
+    that the rescue pass must recover exactly.  One fused config, both
+    lax.cond branches executed at runtime, oracle-exact end to end."""
+    w = 32  # production W: the seam geometry below assumes min_chunk
+    n = 128 * (2 * w + 2)
+    seg = n // 128
+    dense = (b"a " * (n // 2))[:n]  # density 0.5: overflows the slot budget
+    buf = np.full(n, 0x20, dtype=np.uint8)
+    buf[seg - 20: seg + 20] = ord("u")  # crosses the first lane seam
+    buf[10:50] = ord("v")               # plain in-lane overlong
+    words = b"aa bb cc aa "
+    buf[60:60 + len(words)] = np.frombuffer(words, dtype=np.uint8)
+    data = dense + bytes(buf)
+    with _interpret():
+        r = wordcount.count_words(
+            data, _cfg("fused", chunk_bytes=n, rescue_overlong=8))
+    assert r.dropped_count == 0  # both 40-byte runs rescued exactly
+    assert r.as_dict() == oracle.word_counts(data)
+
+
+@pytest.mark.slow
+def test_fused_spill_fallback_parity():
+    """Windows denser than the slot budget must spill into the fused
+    full-resolution fallback and stay bit-identical to the split path's
+    fallback (@slow: two full pipeline compiles, ~50 s on this box;
+    tier-1 keeps the runtime spill path via the oracle test above)."""
+    data = b"a " * 4000  # density 0.5: overflows any 1/3 slot budget
+    with _interpret():
+        a = wordcount.count_words(data, _cfg("split"))
+        b = wordcount.count_words(data, _cfg("fused"))
+    _assert_results_equal(a, b)
+    assert b.as_dict() == oracle.word_counts(data)
+    assert b.total == 4000
+
+
+@pytest.mark.slow
+def test_fused_overlong_rescue_parity():
+    """Overlong tokens — including one crossing a lane seam — are rescued
+    identically on the fused path, with identical accounting (@slow: two
+    full pipeline compiles; tier-1 keeps rescue-on-fused via the oracle
+    test above)."""
+    w = 32  # production W: the seam geometry below assumes min_chunk
+    n = 128 * (2 * w + 2)
+    seg = n // 128
+    buf = np.full(n, 0x20, dtype=np.uint8)
+    buf[seg - 20: seg + 20] = ord("u")  # crosses the first lane seam
+    buf[10:50] = ord("v")               # plain in-lane overlong
+    words = b"aa bb cc aa "
+    buf[60:60 + len(words)] = np.frombuffer(words, dtype=np.uint8)
+    data = bytes(buf)
+    with _interpret():
+        a = wordcount.count_words(
+            data, _cfg("split", chunk_bytes=n, rescue_overlong=8))
+        b = wordcount.count_words(
+            data, _cfg("fused", chunk_bytes=n, rescue_overlong=8))
+    _assert_results_equal(a, b)
+    assert b.dropped_count == 0  # both 40-byte runs rescued exactly
+    assert b.as_dict() == oracle.word_counts(data)
+
+
+@pytest.mark.smoke
+def test_fused_ngram_bit_identical(rng):
+    """The gram family's fused path (full-resolution pair stream straight
+    into the position sort) vs the split col+seam concat."""
+    corpus = make_corpus(rng, n_words=2500, vocab=120)
+    with _interpret():
+        a = wordcount.count_ngrams(corpus, 2, _cfg("split"))
+        b = wordcount.count_ngrams(corpus, 2, _cfg("fused"))
+    _assert_results_equal(a, b)
+
+
+@pytest.mark.slow
+def test_fused_dropped_accounting_parity(rng):
+    """Without rescue, overlong runs land in dropped_* accounting — the
+    fused kernel's in-kernel overlong count (no seam-pass share) must
+    match the split path's two-source sum exactly."""
+    head = b"x" * 50 + b" "  # overlong at W=32, dropped with rescue OFF
+    corpus = head + make_corpus(rng, n_words=3000, vocab=150)
+    with _interpret():
+        a = wordcount.count_words(corpus, _cfg("split", rescue_overlong=0))
+        b = wordcount.count_words(corpus, _cfg("fused", rescue_overlong=0))
+    _assert_results_equal(a, b)
+    assert b.dropped_count >= 1
+
+
+@pytest.mark.slow
+def test_fused_streamed_executor(tmp_path, rng):
+    """Streamed fused run == streamed split run through the real executor
+    (4-device mesh, see test_stable2_streamed_executor for the mesh-width
+    note), byte-identical results and oracle-exact."""
+    from mapreduce_tpu.parallel.mesh import data_mesh
+    from mapreduce_tpu.runtime.executor import count_file
+
+    corpus = make_corpus(rng, n_words=6000, vocab=150)
+    p = tmp_path / "c.txt"
+    p.write_bytes(corpus)
+    with _interpret():
+        a = count_file([str(p)], config=_cfg("split", chunk_bytes=1 << 14),
+                       mesh=data_mesh(4))
+        b = count_file([str(p)], config=_cfg("fused", chunk_bytes=1 << 14),
+                       mesh=data_mesh(4))
+    _assert_results_equal(a, b)
+    assert b.as_dict() == oracle.word_counts(corpus)
+
+
+def test_map_impl_validation():
+    with pytest.raises(ValueError, match="map_impl"):
+        Config(backend="pallas", map_impl="bogus")

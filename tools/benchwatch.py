@@ -118,6 +118,18 @@ def main() -> int:
                  {**env, "BENCH_INFLIGHT": "4"}),
                 ("bench-zipf-nopipeline", [sys.executable, "bench.py"],
                  {**env, "BENCH_INFLIGHT": "1"}),
+                # ISSUE 6 fused-map A/B: one kernel pass over raw chunk
+                # bytes (tokenize -> hash -> window compaction in VMEM, no
+                # token-plane round-trip) vs the shipped split path.  Each
+                # row's BENCH JSON carries its `cost` record, so the
+                # predicted effective_input_passes delta (costcheck gates
+                # fused strictly below split) sits next to the measured
+                # GB/s delta in the same capture — the round-9
+                # confirm-or-record-the-dead-end evidence.
+                ("bench-zipf-fused", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_MAP_IMPL": "fused"}),
+                ("bench-zipf-split", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_MAP_IMPL": "split"}),
                 # Regression A/B rows: the previous default (sort3) and the
                 # uncompacted path.  segmin's stream-sized associative_scan
                 # wedges the chip (3 observations, BENCHMARKS.md round 4) —

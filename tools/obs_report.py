@@ -83,7 +83,15 @@ def classify(phases: dict) -> str:
     """Bound classification over the streaming phases (not the end-of-
     stream tails or reduce: they time the stream END, not the steady
     state).  ``retire_wait`` — blocked on a full dispatch window — means
-    the device is the ceiling and the window is doing its job."""
+    the device is the ceiling and the window is doing its job.
+
+    The classification is map-path-agnostic by design: on a FUSED run
+    (run_start ``map_impl='fused'``) the whole map chain — tokenize
+    included — executes inside ``dispatch``, so a bigger dispatch share
+    than the same corpus' split run is the fusion working, not a
+    regression.  :func:`map_flags` owns that attribution (the split-path
+    tokenize/stage boundary this classifier was first written against no
+    longer holds on fused runs)."""
     streaming = {k: phases.get(k, 0.0)
                  for k in ("read_wait", "stage", "dispatch", "retire_wait")}
     total = sum(streaming.values())
@@ -161,6 +169,27 @@ def pipeline_flags(phases: dict, pipeline: dict | None) -> list:
     return flags
 
 
+def map_flags(header: dict | None, classification: str) -> list:
+    """Map-path attribution (ISSUE 6): a FUSED run moved the whole map
+    chain into the device dispatch, so host-side ceilings mean something
+    different than they did on the split path — call that out instead of
+    letting the split-era reading stand."""
+    impl = (header or {}).get("map_impl")
+    if impl != "fused":
+        return []
+    flags = []
+    if classification in ("stage-bound", "read-bound"):
+        flags.append({
+            "flag": "fused-map-host-bound",
+            "detail": (f"fused map run is {classification}: the fused "
+                       "kernel deleted the device-side seam fix-up and "
+                       "transpose/pad work, so the HOST side (reader/"
+                       "staging) is now the ceiling — raise "
+                       "--prefetch-depth / chunk size before blaming the "
+                       "kernel")})
+    return flags
+
+
 def analyze_run(records: list) -> dict:
     """Summarize one run's records (already filtered to one run_id)."""
     start = next((r for r in records if r["kind"] == "run_start"), None)
@@ -214,15 +243,18 @@ def analyze_run(records: list) -> dict:
     if wall and bytes_done:
         gbps = bytes_done / 1e9 / wall
     pipeline = end.get("pipeline") if end else None
+    header = {k: start.get(k) for k in
+              ("driver", "job", "devices", "chunk_bytes", "superstep",
+               "backend", "map_impl", "merge_strategy", "input",
+               "retry")} if start else None
+    classification = classify(phases)
     return {
         "pipeline": pipeline,
         "overlap_fraction": (pipeline or {}).get("overlap_fraction"),
         "pipeline_flags": pipeline_flags(phases, pipeline),
+        "map_flags": map_flags(header, classification),
         "run_id": records[0].get("run_id"),
-        "header": {k: start.get(k) for k in
-                   ("driver", "job", "devices", "chunk_bytes", "superstep",
-                    "backend", "merge_strategy", "input", "retry")} if start
-        else None,
+        "header": header,
         "completed": end is not None,
         "step_records": len(steps),
         "steps": n_steps,
@@ -230,7 +262,7 @@ def analyze_run(records: list) -> dict:
         "wall_s": wall,
         "gb_per_s": round(gbps, 4) if gbps is not None else None,
         "phases": {k: round(v, 4) for k, v in sorted(phases.items())},
-        "classification": classify(phases),
+        "classification": classification,
         "spikes": spikes,
         "mem_growth": mem_growth,
         "retries": len(retries),
@@ -280,6 +312,10 @@ def render_run(a: dict, out) -> None:
     if a["compile_s"]:
         out.write(f"  (compiles: {a['compile_s']:.2f}s)")
     out.write("\n")
+    if (a["header"] or {}).get("map_impl") == "fused":
+        out.write("  map: fused (whole map chain — tokenize included — "
+                  "runs inside dispatch; read dispatch shares of a "
+                  "fused/split A/B with that in view)\n")
     p = a.get("pipeline")
     if p:
         out.write(f"  pipeline: inflight={p.get('inflight_groups')}  "
@@ -291,6 +327,8 @@ def render_run(a: dict, out) -> None:
         out.write("\n")
     for f in a.get("pipeline_flags", []):
         out.write(f"  PIPELINE {f['flag']}: {f['detail']}\n")
+    for f in a.get("map_flags", []):
+        out.write(f"  MAP {f['flag']}: {f['detail']}\n")
     if a["checkpoints"] or a["retries"]:
         out.write(f"  checkpoints: {a['checkpoints']}  "
                   f"retries: {a['retries']}\n")
@@ -334,7 +372,7 @@ def selftest() -> int:
     ledger = os.path.join(fdir, "mini_ledger.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 2, f"fixture holds two runs, got {len(runs)}"
+    assert len(runs) == 3, f"fixture holds three runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -358,12 +396,26 @@ def selftest() -> int:
     bflags = {f["flag"] for f in b["pipeline_flags"]}
     assert bflags == {"drain-heavy", "overlap-starved",
                       "inflight-window-always-full"}, bflags
-    # The human renderer must run over both artifacts without raising.
+    # Runs 1-2 predate map_impl in the ledger (split-era records): the
+    # header degrades to None and no map flag may fire.
+    assert a["header"]["map_impl"] is None and not a["map_flags"]
+    # Run 3: a FUSED run (ISSUE 6) that is stage-bound — the split-era
+    # reading ("host assembly dominates, kernel fine") is now the
+    # headline fact: the fused kernel deleted device-side map work, so
+    # the host IS the ceiling, and the fused-specific flag must say so.
+    c = runs[2]
+    assert c["header"]["map_impl"] == "fused", c["header"]
+    assert c["classification"] == "stage-bound", c["classification"]
+    assert not c["pipeline_flags"], c["pipeline_flags"]
+    cflags = {f["flag"] for f in c["map_flags"]}
+    assert cflags == {"fused-map-host-bound"}, cflags
+    # The human renderer must run over all artifacts without raising.
     import io
 
     buf = io.StringIO()
     render_run(a, buf)
     render_run(b, buf)
+    render_run(c, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert "ANOMALY step-time spike" in body
@@ -372,11 +424,13 @@ def selftest() -> int:
     assert "PIPELINE inflight-window-never-filled" in body
     assert "PIPELINE drain-heavy" in body
     assert "pipeline: inflight=4" in body
+    assert "map: fused" in body
+    assert "MAP fused-map-host-bound" in body
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
           "1 memory-growth flag, "
           f"{len(a['pipeline_flags']) + len(b['pipeline_flags'])} "
-          "pipeline flags)")
+          f"pipeline flags, {len(c['map_flags'])} map flag)")
     return 0
 
 

@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # Fast iteration gate (VERDICT r5 #7): the <5-minute smoke subset — golden
 # semantics, CLI surface, table units, one pallas-interpret case, config
-# validation, and the costcheck known-bad fixtures — so a mid-PR edit gets
-# a signal in ~a minute instead of the ~12-minute tier-1 run.
+# validation, the costcheck known-bad fixtures, and the ISSUE 6 fused-map
+# seam (stream-level fused-vs-split row-set identity, ngram bit-identity,
+# the oracle-exact fused rescue+spill case, and the fused-below-split
+# cost gate) — so a mid-PR edit gets a signal in minutes instead of the
+# ~12-minute tier-1 run.
 #
 # Green here is NOT the gate: tier-1 (tools/tier1.sh) stays the merge bar
 # and the full suite (no marker filter) the release bar.  Prints

@@ -9,9 +9,13 @@
 #
 # The costcheck/graphcheck clean gate runs FIRST (ISSUE 4 satellite): every
 # registry model through the full pass pipeline — algebra, overflow,
-# host-sync, sharding, hbm-cost (baseline regression), vmem-budget,
-# kernel-race — plus the production kernel-geometry certification.  Any
-# error-severity finding fails tier-1 before a single test runs.
+# host-sync, sharding, hbm-cost (baseline regression + the ISSUE 6
+# fused-vs-split gate: wordcount_fused must price strictly below the
+# split baseline), vmem-budget, kernel-race, fusion-opportunity (INFO
+# candidates; a crash or mis-severity would fail here) — plus the
+# production kernel-geometry certification (fused seam-aux geometry
+# included).  Any error-severity finding fails tier-1 before a single
+# test runs.
 cd "$(dirname "$0")/.." || exit 1
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-models --min-severity error || { echo "TIER1: costcheck gate FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
