@@ -347,10 +347,14 @@ def _write_last_good(result: dict) -> None:
     # budget/timeout knobs only shape pre-measurement reachability retries
     # (documented measurement-neutral at wait_for_device's call site);
     # BENCH_FORCE_LAST_GOOD only changes what THIS function does.
+    # BENCH_TRACE only gates whether the timed pass's ledger is ALSO
+    # rendered to a trace file after the fact — pure post-processing of
+    # records already written, measurement-neutral like BENCH_LEDGER.
     harness_only = {"BENCH_WATCHDOG_S", "BENCH_PROBE",
                     "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE",
                     "BENCH_LEDGER", "BENCH_RETRY_BUDGET_S",
-                    "BENCH_PROBE_TIMEOUT_S", "BENCH_FORCE_LAST_GOOD"}
+                    "BENCH_PROBE_TIMEOUT_S", "BENCH_FORCE_LAST_GOOD",
+                    "BENCH_TRACE"}
     if result.get("input") != "synthetic-zipf":
         _log_refused(f"non-headline corpus {result.get('input')!r} "
                      "(A/B evidence belongs in BENCHMARKS.md)")
@@ -764,6 +768,33 @@ def main() -> int:
                           "depth_max", "window_filled", "full_frac")}
         if streamed_ledger:
             result["ledger"] = streamed_ledger
+            # Timeline forensics (ISSUE 7): reconstruct the timed pass's
+            # per-group lifecycle into the critical-path `bottleneck`
+            # verdict and export a Perfetto-viewable trace NEXT TO the
+            # ledger — the queued pipeline A/B rows land with measured
+            # timelines attached, not just two scalar ratios.  Advisory:
+            # post-processing of records already on disk, so any failure
+            # is logged and skipped (the measured row must survive).
+            # BENCH_TRACE=0 skips (harness knob, measurement-neutral).
+            if os.environ.get("BENCH_TRACE", "1") != "0":
+                try:
+                    from mapreduce_tpu.obs import timeline as tl_mod
+
+                    recs = list(obs.read_ledger(streamed_ledger))
+                    art = tl_mod.reconstruct(recs)
+                    if art is not None:
+                        result["bottleneck"] = art["bottleneck"]
+                        trace_path = streamed_ledger + ".trace.json"
+                        with open(trace_path, "w") as tf:
+                            json.dump(tl_mod.to_chrome_trace(recs), tf)
+                        result["trace"] = trace_path
+                        _log("trace exported: "
+                             f"{trace_path} (bottleneck="
+                             f"{art['bottleneck']['resource']}, device idle "
+                             f"{art['device_idle']['total_s']:.3f}s)", wall0)
+                except Exception as e:  # noqa: BLE001 — advisory only
+                    print(f"[bench] trace export skipped ({e!r})",
+                          file=sys.stderr)
         # Registry DELTA over the timed streamed pass (the registry is
         # process-global, so an absolute snapshot would fold in the
         # headline + warm-up activity): steps/dispatches/prefetches and

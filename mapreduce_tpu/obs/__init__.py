@@ -11,21 +11,27 @@ regions — all still used, now fed through one layer):
   summary on the failure path;
 * :mod:`.spans` — profiler-region + phase-timer spans so XProf timelines
   line up with ledger records;
+* :mod:`.timeline` — jax-free reconstruction of per-group ``group``
+  lifecycle records into per-resource timelines, overlap matrices,
+  device-idle gap attribution and a critical-path ``bottleneck`` verdict
+  (ISSUE 7); ``tools/trace_export.py`` renders the same records as
+  Perfetto-viewable Chrome trace-event JSON;
 * :mod:`.telemetry` — the facade the executor takes as ONE optional arg.
 
 Reporting: ``tools/obs_report.py`` renders a ledger/flight pair into a run
 summary with anomaly flags.  Schemas: ``docs/observability.md``.
 """
 
+from mapreduce_tpu.obs import timeline
 from mapreduce_tpu.obs.flight import FlightRecorder, summarize_state
-from mapreduce_tpu.obs.ledger import RunLedger, read_ledger
+from mapreduce_tpu.obs.ledger import LEDGER_VERSION, RunLedger, read_ledger
 from mapreduce_tpu.obs.registry import MetricsRegistry, get_registry
 from mapreduce_tpu.obs.spans import span
 from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
                                          maybe)
 
 __all__ = [
-    "FlightRecorder", "MetricsRegistry", "RunLedger", "Telemetry",
-    "device_memory_stats", "get_registry", "maybe", "read_ledger", "span",
-    "summarize_state",
+    "FlightRecorder", "LEDGER_VERSION", "MetricsRegistry", "RunLedger",
+    "Telemetry", "device_memory_stats", "get_registry", "maybe",
+    "read_ledger", "span", "summarize_state", "timeline",
 ]

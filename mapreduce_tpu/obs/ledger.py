@@ -16,17 +16,30 @@ Record kinds (full schema: docs/observability.md):
 =============  ===========================================================
 kind           carries
 =============  ===========================================================
-run_start      run_id, config summary (devices, chunk_bytes, superstep,
-               backend, map_impl, input paths), resume cursor
+run_start      run_id, ledger_version, config summary (devices,
+               chunk_bytes, superstep, backend, map_impl, input paths),
+               resume cursor
 step           step_first/step_last/steps, group_bytes, cursor_bytes,
                per-phase second deltas (read_wait/stage/dispatch/...),
                elapsed_s since the previous record, device memory stats,
                compile events landed since the previous record, retries
+group          one per RETIRED superstep group (ISSUE 7): monotonic-clock
+               lifecycle timestamps (read_at/staged_at/dispatched_at/
+               token_ready_at/retired_at, h2d_done_at on the last group),
+               group bytes/steps, retire_wait_s, retry attempts — the raw
+               material ``obs/timeline.py`` reconstructs per-resource
+               timelines, overlap matrices and critical-path verdicts from
 checkpoint     step, cursor_bytes, save_s, path
 retry          step, attempt, error
 failure        step, cursor_bytes, error, flight-dump path (if written)
 run_end        RunMetrics summary (bytes, words, elapsed, phases, GB/s)
 =============  ===========================================================
+
+Forward compatibility (ISSUE 7 satellite): ``run_start`` records carry
+``ledger_version``; every consumer (:func:`read_ledger`, ``obs_report``,
+``timeline``, ``trace_export``) skips unknown record kinds and unknown
+fields instead of erroring, so a ledger written by a NEWER version of this
+code still renders on an older reader — and vice versa.
 
 Readers: :func:`read_ledger` here (used by tests) and ``tools/obs_report.py``
 (the human/anomaly report; deliberately jax-free so it runs anywhere).
@@ -38,6 +51,11 @@ import json
 import os
 import time
 from typing import Iterator, Optional
+
+#: Bumped when the record stream gains kinds/fields a consumer may care to
+#: version-gate on.  1 = ISSUE 2-6 shape (implicit; pre-ISSUE-7 ledgers
+#: carry no version field at all); 2 = adds ``group`` lifecycle records.
+LEDGER_VERSION = 2
 
 
 class RunLedger:
@@ -54,6 +72,10 @@ class RunLedger:
         self.records_written = 0
 
     def write(self, kind: str, **fields) -> None:
+        if kind == "run_start":
+            # Every writer stamps the stream's schema version exactly once,
+            # without each call site having to remember to.
+            fields.setdefault("ledger_version", LEDGER_VERSION)
         rec = {"ts": round(time.time(), 6), "run_id": self.run_id,
                "kind": kind, **fields}
         self._f.write(json.dumps(rec, default=_json_default) + "\n")

@@ -22,6 +22,14 @@ A process-global default registry (:func:`get_registry`) serves the layers
 that have no run-scoped handle (the reader's prefetch thread, module-level
 collective builds); run-scoped telemetry (:class:`...obs.telemetry.Telemetry`)
 binds to it by default so one snapshot carries everything.
+
+The seconds-scale :data:`DEFAULT_BUCKETS` also carry the per-group
+lifecycle observations the window retirement path emits (ISSUE 7):
+``executor.groups_retired`` (counter), ``executor.group_device_seconds``
+(dispatch-enqueue to observed token readiness) and
+``executor.retire_wait_seconds`` (how long the retire actually blocked) —
+the registry-side aggregate of what the ledger's ``group`` records carry
+per group and ``obs/timeline.py`` reconstructs into lanes.
 """
 
 from __future__ import annotations

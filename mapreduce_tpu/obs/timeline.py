@@ -1,0 +1,343 @@
+"""Measured pipeline timeline: reconstruct per-resource lanes from a run
+ledger's ``group`` records (ISSUE 7 tentpole).
+
+The dispatch window (ISSUE 5) made streamed ingest an overlapped pipeline,
+but the ledger recorded only per-step aggregated phase *deltas* — which
+resource (reader, host staging, H2D, device compute, retire) actually
+bounded a run, and where the device sat idle between groups, was
+unobservable.  The executor now stamps every superstep group's lifecycle
+with monotonic-clock timestamps and emits one ``group`` record per retired
+group; this module turns those records back into:
+
+* a per-resource **interval timeline** (``lanes``): merged busy intervals
+  per lane, normalized to the run's first observation;
+* a **measured overlap matrix** (``overlap_s``): pairwise concurrency
+  seconds between lanes — the measured counterpart of the run-end
+  ``overlap_fraction`` scalar;
+* **device-idle gap analysis** (``device_idle``): every gap between device
+  busy intervals, attributed to the lane that was blocking (covering the
+  most of the gap) when it opened;
+* a **critical-path verdict** (``bottleneck``): the bounding resource and
+  the projected wall-clock saving if it were infinitely fast — the
+  machine-readable dict the window autotuner (ROADMAP item 1) consumes.
+
+Lane semantics (host-observed; nothing here adds a device sync):
+
+==========  ===============================================================
+lane        interval per group
+==========  ===============================================================
+reader      ``read_at -> staged_at``: the group's batches leaving the
+            prefetching reader and accumulating into a superstep group
+staging     ``staged_at -> dispatched_at``: host assembly + H2D placement
+            enqueue + program enqueue (the ``stage``/``dispatch`` phases)
+h2d         ``staged_at -> h2d_done_at``: present only where the executor
+            explicitly observed the transfer complete (the end-of-stream
+            ``h2d_tail`` wait); per-group H2D completion is not
+            host-observable without the very sync the window exists to
+            avoid — finer splits are XProf's job
+device      ``dispatched_at -> token_ready_at``: enqueue to the observed
+            readiness of the group's completion token (an upper bound:
+            the token may have been ready before the loop looked;
+            ``retire_wait_s`` says how long the look actually blocked)
+retire      ``token_ready_at -> retired_at``: retire bookkeeping (window
+            pop, staging-buffer recycling)
+==========  ===============================================================
+
+The critical-path model: a lane's **exclusive seconds** (active while no
+other lane is) are the only seconds an infinitely fast version of it could
+remove from the measured span — overlapped seconds are covered by other
+work by construction.  The bounding resource is the lane with the most
+exclusive time.
+
+Deliberately jax-free and import-free of the rest of the package, so
+``tools/obs_report.py`` / ``tools/trace_export.py`` can load this module
+by file path on a box that has neither jax nor the package installed.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+#: Resource lanes, in display/tie-break order.
+LANES: Tuple[str, ...] = ("reader", "staging", "h2d", "device", "retire")
+
+_Interval = Tuple[float, float]
+
+
+# -- interval arithmetic ----------------------------------------------------
+
+def _merge(intervals: Iterable[_Interval]) -> List[_Interval]:
+    """Sorted, coalesced intervals (touching intervals merge)."""
+    out: List[List[float]] = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _total(intervals: Iterable[_Interval]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _intersection_s(a: List[_Interval], b: List[_Interval]) -> float:
+    """Total intersection seconds of two MERGED interval lists."""
+    i = j = 0
+    tot = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            tot += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return tot
+
+
+def _cover_s(intervals: List[_Interval], lo: float, hi: float) -> float:
+    """Seconds of ``intervals`` falling inside ``[lo, hi]``."""
+    tot = 0.0
+    for s, e in intervals:
+        s2, e2 = max(s, lo), min(e, hi)
+        if e2 > s2:
+            tot += e2 - s2
+    return tot
+
+
+def _exclusive_s(lanes: dict) -> dict:
+    """Per-lane seconds active while NO other lane is (sweep over the
+    merged intervals) — the measured critical-path attribution."""
+    events = []
+    for lane, intervals in lanes.items():
+        for s, e in intervals:
+            events.append((s, 0, lane))
+            events.append((e, 1, lane))
+    events.sort(key=lambda ev: (ev[0], ev[1]))
+    active = {lane: 0 for lane in lanes}
+    excl = {lane: 0.0 for lane in lanes}
+    prev: Optional[float] = None
+    for t, kind, lane in events:
+        if prev is not None and t > prev:
+            on = [ln for ln, n in active.items() if n > 0]
+            if len(on) == 1:
+                excl[on[0]] += t - prev
+        active[lane] += 1 if kind == 0 else -1
+        prev = t
+    return excl
+
+
+# -- group records -> intervals ---------------------------------------------
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def group_intervals(rec: dict) -> Optional[dict]:
+    """One ``group`` record's lane intervals (absolute monotonic seconds).
+    Returns None for records missing the core lifecycle (forward compat:
+    a future record shape is skipped, never an error); zero-length
+    intervals are dropped."""
+    s = _num(rec.get("staged_at"))
+    d = _num(rec.get("dispatched_at"))
+    t = _num(rec.get("token_ready_at"))
+    e = _num(rec.get("retired_at"))
+    if None in (s, d, t, e):
+        return None
+    out = {}
+    r = _num(rec.get("read_at"))
+    if r is not None and s > r:
+        out["reader"] = (r, s)
+    if d > s:
+        out["staging"] = (s, d)
+    if t > d:
+        out["device"] = (d, t)
+    if e > t:
+        out["retire"] = (t, e)
+    h = _num(rec.get("h2d_done_at"))
+    if h is not None and h > s:
+        out["h2d"] = (s, min(h, e))
+    return out or None
+
+
+def iter_groups(records: Iterable[dict],
+                run_id: Optional[str] = None) -> Iterator[dict]:
+    """The ``group`` records of one run (the first run carrying any, when
+    ``run_id`` is not given).  Unknown kinds and malformed rows skip."""
+    chosen = run_id
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "group":
+            continue
+        if chosen is None:
+            chosen = rec.get("run_id")
+        if rec.get("run_id") == chosen:
+            yield rec
+
+
+# -- the reconstruction -----------------------------------------------------
+
+def reconstruct(records: Iterable[dict],
+                run_id: Optional[str] = None) -> Optional[dict]:
+    """Ledger records -> the timeline artifact (see module docstring), or
+    None when the run carries no usable ``group`` records (pre-ISSUE-7
+    ledgers degrade to "no timeline", never to an error).
+
+    All times in the artifact are seconds relative to the run's first
+    observed lifecycle timestamp (``t0``), rounded to microseconds.
+    """
+    groups = []
+    for rec in iter_groups(records, run_id):
+        iv = group_intervals(rec)
+        if iv is not None:
+            groups.append((rec, iv))
+    if not groups:
+        return None
+    raw: dict = {lane: [] for lane in LANES}
+    for _, iv in groups:
+        for lane, span in iv.items():
+            raw[lane].append(span)
+    t0 = min(s for spans in raw.values() for s, _ in spans)
+    lanes = {lane: _merge([(s - t0, e - t0) for s, e in spans])
+             for lane, spans in raw.items()}
+    t_end = max(e for spans in lanes.values() for _, e in spans)
+
+    busy = {lane: round(_total(spans), 6) for lane, spans in lanes.items()}
+    overlap = {}
+    for i, a in enumerate(LANES):
+        for b in LANES[i + 1:]:
+            if lanes[a] and lanes[b]:
+                overlap[f"{a}+{b}"] = round(
+                    _intersection_s(lanes[a], lanes[b]), 6)
+
+    # Device-idle gaps, each attributed to the lane covering most of it.
+    gaps = []
+    blocked_on: dict = {}
+    dev = lanes["device"]
+    for (_, e0), (s1, _) in zip(dev, dev[1:]):
+        best, best_cov = "idle", 0.0
+        for lane in LANES:
+            if lane == "device" or not lanes[lane]:
+                continue
+            cov = _cover_s(lanes[lane], e0, s1)
+            if cov > best_cov + 1e-12:
+                best, best_cov = lane, cov
+        gaps.append({"start": round(e0, 6), "end": round(s1, 6),
+                     "s": round(s1 - e0, 6), "blocking": best,
+                     "blocking_s": round(best_cov, 6)})
+        blocked_on[best] = round(blocked_on.get(best, 0.0) + (s1 - e0), 6)
+    idle_total = round(sum(g["s"] for g in gaps), 6)
+
+    excl = _exclusive_s(lanes)
+    populated = [lane for lane in LANES if lanes[lane]]
+    resource = max(populated, key=lambda ln: (excl[ln], busy[ln]))
+    saving = excl[resource]
+    span = t_end
+    bottleneck = {
+        "resource": resource,
+        "busy_s": busy[resource],
+        "exclusive_s": round(saving, 6),
+        "projected_saving_s": round(saving, 6),
+        "projected_span_s": round(span - saving, 6),
+        "span_s": round(span, 6),
+        "device_busy_s": busy.get("device", 0.0),
+        "device_idle_s": idle_total,
+        "detail": (f"{resource} is the measured critical path: "
+                   f"{saving:.3f}s of the {span:.3f}s span is "
+                   f"{resource}-exclusive — an infinitely fast {resource} "
+                   f"saves ~{saving:.3f}s "
+                   f"({100 * saving / span:.0f}% of span)" if span > 0
+                   else f"{resource} (degenerate zero-length span)"),
+    }
+    return {
+        "run_id": groups[0][0].get("run_id"),
+        "groups": len(groups),
+        "t0": round(t0, 6),
+        "span_s": round(span, 6),
+        "lanes": {lane: [[round(s, 6), round(e, 6)] for s, e in spans]
+                  for lane, spans in lanes.items()},
+        "lane_busy_s": busy,
+        "exclusive_s": {lane: round(v, 6) for lane, v in excl.items()},
+        "overlap_s": overlap,
+        "device_idle": {"total_s": idle_total, "gaps": gaps,
+                        "blocked_on": blocked_on},
+        "bottleneck": bottleneck,
+    }
+
+
+# -- Chrome trace-event rendering -------------------------------------------
+
+# Slice names per lane (what a Perfetto track shows on each group's slice).
+_SLICE = {"reader": "read", "staging": "stage", "h2d": "h2d",
+          "device": "compute", "retire": "retire"}
+
+
+def to_chrome_trace(records: Iterable[dict],
+                    run_id: Optional[str] = None) -> Optional[dict]:
+    """Ledger records -> Chrome trace-event JSON (the ``tools/
+    trace_export.py`` payload): one **pid per resource lane**, one **tid
+    per group**, complete (``ph="X"``) slices for every lifecycle
+    interval, flow arrows dispatch -> token_ready, and instant markers on
+    the device lane for every attributed idle gap.  Open the written file
+    in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.
+
+    Returns None when the run has no usable ``group`` records.
+    """
+    records = list(records)
+    art = reconstruct(records, run_id)
+    if art is None:
+        return None
+    pid = {lane: i + 1 for i, lane in enumerate(LANES)}
+    events = []
+    for lane in LANES:
+        events.append({"ph": "M", "name": "process_name", "pid": pid[lane],
+                       "args": {"name": lane}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid[lane], "args": {"sort_index": pid[lane]}})
+    t0 = art["t0"]
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    named_threads = set()
+    for rec in iter_groups(records, art["run_id"]):
+        iv = group_intervals(rec)
+        if iv is None:
+            continue
+        gid = int(rec.get("step_first", 0))
+        label = f"g{rec.get('step_first', '?')}-{rec.get('step_last', '?')}"
+        args = {k: rec.get(k) for k in
+                ("step_first", "step_last", "steps", "group_bytes",
+                 "retries", "retire_wait_s") if rec.get(k) is not None}
+        for lane, (s, e) in iv.items():
+            if (pid[lane], gid) not in named_threads:
+                named_threads.add((pid[lane], gid))
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid[lane], "tid": gid,
+                               "args": {"name": f"group {label}"}})
+            events.append({"ph": "X", "cat": "lane",
+                           "name": f"{_SLICE[lane]} {label}",
+                           "pid": pid[lane], "tid": gid, "ts": us(s),
+                           "dur": round((e - s) * 1e6, 3), "args": args})
+        # Flow arrow: the dispatch hand-off from the staging lane into the
+        # device lane (binds to the enclosing slices at each end).
+        if "staging" in iv and "device" in iv:
+            events.append({"ph": "s", "cat": "dispatch", "name": "dispatch",
+                           "id": gid, "pid": pid["staging"], "tid": gid,
+                           "ts": us(iv["staging"][1])})
+            events.append({"ph": "f", "bp": "e", "cat": "dispatch",
+                           "name": "dispatch", "id": gid,
+                           "pid": pid["device"], "tid": gid,
+                           "ts": us(iv["device"][1])})
+    for gap in art["device_idle"]["gaps"]:
+        events.append({"ph": "i", "s": "p", "cat": "idle",
+                       "name": f"device idle {gap['s']:.3f}s: "
+                               f"blocked on {gap['blocking']}",
+                       "pid": pid["device"], "tid": 0,
+                       "ts": round(gap["start"] * 1e6, 3),
+                       "args": dict(gap)})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"run_id": art["run_id"], "groups": art["groups"],
+                          "bottleneck": art["bottleneck"]}}

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 import weakref
 from typing import Any, Optional
 
@@ -200,6 +201,43 @@ class _Inflight:
     staged: Any  # staged chunks handle (h2d tail timing; pool recycling)
     step_first: int
     cursor_before: int  # bytes_done before this group (honest failure cursor)
+    life: dict  # lifecycle timestamps + sizes (the `group` ledger record)
+
+
+def _group_life(group, read_at: Optional[float], group_bytes: int) -> dict:
+    """Start a group's lifecycle record (ISSUE 7): identity, sizes, and the
+    monotonic-clock timestamps stamped so far.  ``staged_at`` is stamped
+    here — the caller invokes this immediately before staging begins.
+    ``group_bytes`` is computed once by the caller and shared with the
+    step-record accounting (no second pass over the batch lengths)."""
+    return {"step_first": group[0].step, "step_last": group[-1].step,
+            "steps": group[-1].step - group[0].step + 1,
+            "group_bytes": group_bytes,
+            "read_at": round(read_at, 6) if read_at is not None else None,
+            "staged_at": round(time.perf_counter(), 6)}
+
+
+def _group_record(tel, write: bool, life: dict, token_ready_at: float,
+                  retired_at: float, wait_s: float, retries: int = 0) -> None:
+    """Emit one ``group`` ledger record for a RETIRED group — the lifecycle
+    raw material ``obs/timeline.py`` reconstructs lanes from.  Pure
+    host-side bookkeeping: a handful of ``perf_counter`` stamps and one
+    JSONL append (same cost class as the step record written at dispatch);
+    a unit test holds the non-I/O part under 1 ms per group."""
+    tel.registry.counter("executor.groups_retired").inc()
+    d = life.get("dispatched_at")
+    if d is not None:
+        tel.registry.observe("executor.group_device_seconds",
+                             max(0.0, token_ready_at - d))
+    tel.registry.observe("executor.retire_wait_seconds", max(0.0, wait_s))
+    rec = {k: v for k, v in life.items() if v is not None}
+    rec["token_ready_at"] = round(token_ready_at, 6)
+    rec["retired_at"] = round(retired_at, 6)
+    rec["retire_wait_s"] = round(max(0.0, wait_s), 6)
+    if retries:
+        rec["retries"] = retries
+    if write:
+        tel.ledger_write("group", **rec)
 
 
 def _drive_stream(engine, job, config: Config, path, state,
@@ -255,12 +293,18 @@ def _drive_stream(engine, job, config: Config, path, state,
     step record per dispatched group, written at dispatch in step order
     (completion is observed later under pipelining), carrying phase deltas,
     bytes, the in-flight depth after the dispatch, and device memory stats;
-    flight-recorder events per dispatch / retry / checkpoint, dumped with a
-    state summary when the failure path runs.  Disabled telemetry (the
-    ``None`` default) does no per-step work and — the invariant the
+    plus exactly one ``group`` record per RETIRED group (ISSUE 7), written
+    at retirement, carrying the group's monotonic-clock lifecycle
+    (``read_at``/``staged_at``/``dispatched_at``/``token_ready_at``/
+    ``retired_at``) — the per-resource timeline ``obs/timeline.py``
+    reconstructs lanes, overlap matrices and the critical-path verdict
+    from; flight-recorder events per dispatch / retry / checkpoint, dumped
+    with a state summary when the failure path runs.  Disabled telemetry
+    (the ``None`` default) does no per-step work and — the invariant the
     graphcheck host-sync pass certifies — never adds a host sync to the
     dispatch pipeline either way: everything here is host-side bookkeeping
-    around async enqueues.
+    around async enqueues (the lifecycle adds ~5 ``perf_counter`` stamps
+    per group, never a device wait that was not already there).
     """
     bytes_done = int(start_offset)
     step_index = start_step
@@ -283,6 +327,10 @@ def _drive_stream(engine, job, config: Config, path, state,
     anchor = None
     since_anchor: list = []
     last_file_dispatched = resumed_file or 0
+    # step -> monotonic arrival time of the batch out of the prefetching
+    # reader: a group's `read_at` is its FIRST batch's arrival (the reader
+    # lane of the timeline spans read + superstep accumulation).
+    read_t: dict = {}
     pipe = {"inflight_groups": window_cap,
             "prefetch_depth": config.resolved_prefetch_depth,
             "dispatch_groups": 0, "depth_sum": 0, "depth_max": 0,
@@ -399,7 +447,7 @@ def _drive_stream(engine, job, config: Config, path, state,
             anchor = hooks.snapshot(state)
         del since_anchor[:]
 
-    def recover(state, e, entry=None, sync_group=None):
+    def recover(state, e, entry=None, sync_group=None, sync_life=None):
         """A group's program failed — either surfaced at its completion
         token (``entry``: the oldest in-flight group; tokens are blocked in
         dispatch order, so it is provably the EARLIEST failure) or raised
@@ -420,6 +468,15 @@ def _drive_stream(engine, job, config: Config, path, state,
             replay.append((sync_group, cursor))
         fail_idx = next(i for i, (g, _) in enumerate(replay)
                         if g[0].step == fail_step)
+        # Lifecycle records still owed: the doomed window's groups never
+        # retired (their records are emitted by the replay below, with
+        # coarse serialized timestamps — the replay IS when they actually
+        # completed); groups in `since_anchor` but NOT in the window
+        # retired earlier and already own a record, so the replay must not
+        # emit a second one for them (exactly-one-per-retired-group).
+        lost = {en.step_first: en.life for en in window}
+        if sync_group is not None and sync_life is not None:
+            lost[sync_group[0].step] = sync_life
         # Drop the doomed window, returning pool-issued staging buffers so
         # their ids never dangle in the pool's issued set (a freed buffer's
         # id can be reused by a reader-owned array, which give() would then
@@ -434,10 +491,25 @@ def _drive_stream(engine, job, config: Config, path, state,
         state = hooks.restage(anchor)
         used = [1]
         for i, (group, group_cursor) in enumerate(replay):
+            replay_t0 = time.perf_counter()
             state = serial_dispatch(
                 state, group, attempts_used=1 if i == fail_idx else 0,
                 used_out=used if i == fail_idx else None,
                 cursor=group_cursor)
+            life = lost.pop(group[0].step, None)
+            if life is not None:
+                # Coarse serialized stamps: the original enqueue was doomed
+                # with the window, so the replay's blocking re-dispatch is
+                # the group's real completion interval (stage/dispatch/
+                # wait are not separable from out here — a timeline shows
+                # one serialized device slab, which is the truth).
+                done = time.perf_counter()
+                life = dict(life, staged_at=round(replay_t0, 6),
+                            dispatched_at=round(replay_t0, 6))
+                _group_record(tel, hooks.write_gate(), life,
+                              token_ready_at=done, retired_at=done,
+                              wait_s=done - replay_t0,
+                              retries=used[0] if i == fail_idx else 0)
         tel.registry.counter("executor.retry_recoveries").inc()
         if sync_group is not None:
             # The sync-failed group raised inside `dispatch` itself, so it
@@ -446,7 +518,10 @@ def _drive_stream(engine, job, config: Config, path, state,
             # (ledger consumers rely on inflight_depth >= 1, and the depth
             # mean divides by dispatch_groups).
             record_depth(1)
-            account(sync_group, depth=1, retries=used[0])
+            account(sync_group, depth=1,
+                    group_bytes=sync_life["group_bytes"] if sync_life
+                    else int(sum(int(b.lengths.sum()) for b in sync_group)),
+                    retries=used[0])
         reanchor(state)
         return state
 
@@ -455,6 +530,7 @@ def _drive_stream(engine, job, config: Config, path, state,
         completion token is ready); recycle its staging buffer.  An error
         surfacing here belongs to exactly this group."""
         entry = window[0]
+        wait_t0 = time.perf_counter()
         try:
             if phase is not None:
                 with obs.span(phase, timer):
@@ -463,9 +539,14 @@ def _drive_stream(engine, job, config: Config, path, state,
                 _wait_token(entry.token)
         except Exception as e:
             return recover(state, e, entry=entry)
+        token_ready_at = time.perf_counter()
         window.popleft()
         if hooks.stage_release is not None:
             hooks.stage_release(entry.staged)
+        _group_record(tel, hooks.write_gate(), entry.life,
+                      token_ready_at=token_ready_at,
+                      retired_at=time.perf_counter(),
+                      wait_s=token_ready_at - wait_t0)
         return state
 
     def drain_window(state, phase="retire_wait", do_reanchor=True):
@@ -491,26 +572,27 @@ def _drive_stream(engine, job, config: Config, path, state,
         pipe["depth_max"] = max(pipe["depth_max"], depth)
         tel.registry.observe("executor.inflight_depth", depth)
 
-    def account(group, depth, retries=0):
+    def account(group, depth, group_bytes, retries=0):
         """Advance the cursor, bases, and telemetry for one dispatched
         group: the ledger step record is written at dispatch, in step
-        order — one per dispatched group, completion observed later."""
+        order — one per dispatched group, completion observed later.
+        ``group_bytes`` comes from the caller's lifecycle record: the
+        batch lengths are summed exactly once per group."""
         nonlocal bytes_done, step_index, last_file_dispatched
         last_file_dispatched = group[-1].file_index
         for b in group:
             bases_list.append(b.base_offsets)
-            bytes_done += int(b.lengths.sum())
+        bytes_done += group_bytes
         step_index = group[-1].step + 1
         tel.step_record(step_first=group[0].step, step_last=group[-1].step,
-                        group_bytes=int(sum(int(b.lengths.sum())
-                                            for b in group)),
+                        group_bytes=group_bytes,
                         cursor_bytes=bytes_done, timer=timer,
                         retries=retries, inflight_depth=depth,
                         write=hooks.write_gate())
         if progress_every and step_index % progress_every < len(group):
             log_event(logger, "progress", step=step_index, bytes=bytes_done)
 
-    def enroll(out, staged, group, cursor_before):
+    def enroll(out, staged, group, cursor_before, life):
         """Window bookkeeping + accounting for a DISPATCHED group.  Runs
         outside the recover() routing on purpose: a failure here (say the
         ledger's disk filling up mid step-record) is host bookkeeping, not
@@ -520,14 +602,15 @@ def _drive_stream(engine, job, config: Config, path, state,
         the pre-window loop's accounting (outside its retry try) did."""
         window.append(_Inflight(
             token=_state_token(out), staged=staged,
-            step_first=group[0].step, cursor_before=cursor_before))
+            step_first=group[0].step, cursor_before=cursor_before,
+            life=life))
         if hooks.retry > 0:
             # Paired with the pre-group cursor, so a replay that later
             # exhausts its retries can report where THIS group started.
             since_anchor.append((group, cursor_before))
         depth = len(window)
         record_depth(depth)
-        account(group, depth)
+        account(group, depth, life["group_bytes"])
 
     def flush(state, group):
         """Dispatch a group of consecutive batches (one superstep, split at
@@ -560,14 +643,24 @@ def _drive_stream(engine, job, config: Config, path, state,
                 pipe["full_retires"] += 1
                 state = retire_oldest(state)
         cursor_before = bytes_done
+        # Lifecycle (ISSUE 7): read_at = the group's first batch leaving
+        # the reader; staged_at is stamped by _group_life right here, just
+        # before staging begins; later steps' arrival stamps are dropped
+        # (the reader lane spans read + superstep accumulation).
+        read_at = read_t.pop(group[0].step, None)
+        for b in group[1:]:
+            read_t.pop(b.step, None)
+        life = _group_life(group, read_at,
+                           int(sum(int(b.lengths.sum()) for b in group)))
         try:
             out, staged = dispatch(state, group)
         except Exception as e:
             # Only the dispatch itself routes here: a device/staging fault
             # for a group that was never enrolled (see enroll()).
-            state = recover(state, e, sync_group=group)
+            state = recover(state, e, sync_group=group, sync_life=life)
         else:
-            enroll(out, staged, group, cursor_before)
+            life["dispatched_at"] = round(time.perf_counter(), 6)
+            enroll(out, staged, group, cursor_before, life)
             state = out
         if (checkpoint_every and checkpoint_path
                 and step_index // checkpoint_every > last_ckpt):
@@ -634,6 +727,7 @@ def _drive_stream(engine, job, config: Config, path, state,
             batch = next(it, None)
         if batch is None:
             break
+        read_t[batch.step] = time.perf_counter()
         if hooks.stage_arrival is not None:
             with obs.span("stage", timer):
                 batch = hooks.stage_arrival(batch)
@@ -664,6 +758,11 @@ def _drive_stream(engine, job, config: Config, path, state,
     with obs.span("h2d_tail", timer):
         if window:
             jax.block_until_ready(window[-1].staged)
+            # The one per-group H2D completion the loop DOES observe (the
+            # reader ran dry, so this wait serializes nothing): the last
+            # group's record carries it, giving the timeline a measured
+            # h2d lane interval instead of pure inference.
+            window[-1].life["h2d_done_at"] = round(time.perf_counter(), 6)
     with obs.span("compute_tail", timer):
         state = drain_window(state, phase=None, do_reanchor=False)
     n_groups = pipe["dispatch_groups"]

@@ -81,6 +81,19 @@ def test_ledger_roundtrip_and_corrupt_line_skipped(tmp_path):
     assert len(steps) == 1 and steps[0]["group_bytes"] == 100
 
 
+def test_ledger_run_start_carries_version(tmp_path):
+    """Forward compat (ISSUE 7 satellite): every writer stamps the stream
+    schema version on run_start — and only there — without call sites
+    having to remember it."""
+    p = str(tmp_path / "run.jsonl")
+    with obs.RunLedger(p, run_id="r1") as led:
+        led.write("run_start", devices=1)
+        led.write("step", step_first=0)
+    recs = list(obs.read_ledger(p))
+    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 2
+    assert "ledger_version" not in recs[1]
+
+
 def test_ledger_coerces_numpy_fields(tmp_path):
     """A ledger write must never take down the run: numpy scalars AND
     arrays coerce to JSON instead of raising out of json.dumps."""

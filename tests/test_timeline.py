@@ -1,0 +1,350 @@
+"""Pipeline timeline tests (ISSUE 7): per-group `group` lifecycle records
+out of the executor, the jax-free timeline reconstruction (lanes, overlap
+matrix, device-idle attribution, critical-path verdict), Chrome
+trace-event export, ledger forward compatibility, and the <1 ms per-group
+overhead bound."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mapreduce_tpu import obs
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models.wordcount import WordCountJob
+from mapreduce_tpu.obs import timeline
+from mapreduce_tpu.parallel.mesh import data_mesh
+from mapreduce_tpu.runtime import executor
+
+from conftest import make_corpus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tools", "fixtures")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+try:
+    import trace_export
+finally:
+    sys.path.pop(0)
+
+
+def _streamed_ledger(tmp_path, inflight: int, n_words=2500):
+    """One telemetered streamed CPU run -> (ledger records, corpus bytes).
+    Module-scoped below: streamed runs are the expensive part of this
+    module, so every test reads the same two ledgers (tier-1 budget)."""
+    import numpy as np
+
+    corpus = make_corpus(np.random.default_rng(20260729 + inflight),
+                         n_words, 120)
+    path = tmp_path / f"data_w{inflight}.txt"
+    path.write_bytes(corpus)
+    cfg = Config(chunk_bytes=512, table_capacity=2048,
+                 inflight_groups=inflight)
+    led = str(tmp_path / f"run_w{inflight}.jsonl")
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        executor.run_job(WordCountJob(cfg), str(path), cfg,
+                         mesh=data_mesh(4), telemetry=tel)
+    return list(obs.read_ledger(led)), len(corpus)
+
+
+@pytest.fixture(scope="module")
+def piped_ledger(tmp_path_factory):
+    """Records of one pipelined (inflight=3) telemetered streamed run."""
+    return _streamed_ledger(tmp_path_factory.mktemp("tl_piped"), inflight=3,
+                            n_words=4000)
+
+
+@pytest.fixture(scope="module")
+def serial_ledger(tmp_path_factory):
+    """Records of the serialized A/B control (inflight=1) run."""
+    return _streamed_ledger(tmp_path_factory.mktemp("tl_serial"),
+                            inflight=1)
+
+
+# -- executor emission ------------------------------------------------------
+
+@pytest.mark.smoke
+def test_one_group_record_per_retired_group(piped_ledger):
+    """ISSUE 7 acceptance: exactly one `group` record per retired group
+    (= one per step record: every dispatched group retired), each with
+    monotonically ordered lifecycle timestamps and sizes that agree with
+    its step record."""
+    recs, corpus_bytes = piped_ledger
+    steps = [r for r in recs if r["kind"] == "step"]
+    groups = [r for r in recs if r["kind"] == "group"]
+    assert len(groups) == len(steps) > 1
+    # Same identity + size as the step records (written at dispatch; the
+    # group records are written at retirement — joinable by step_first).
+    by_first = {r["step_first"]: r for r in steps}
+    for g in groups:
+        s = by_first[g["step_first"]]
+        assert g["step_last"] == s["step_last"]
+        assert g["steps"] == s["steps"]
+        assert g["group_bytes"] == s["group_bytes"]
+        assert (g["read_at"] <= g["staged_at"] <= g["dispatched_at"]
+                <= g["token_ready_at"] <= g["retired_at"]), g
+        assert g["retire_wait_s"] >= 0
+    assert sum(g["group_bytes"] for g in groups) == corpus_bytes
+    # run_start carries the stream schema version (forward-compat anchor).
+    start = next(r for r in recs if r["kind"] == "run_start")
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 2
+
+
+def test_serial_window_is_gap_free_control(serial_ledger):
+    """inflight_groups=1 (the A/B control) degenerates to a serial
+    timeline: device intervals never overlap (no merged concurrency),
+    staging never runs under device compute, and every device-idle gap is
+    attributed to measured host work — the timeline of a run with no
+    pipeline to measure."""
+    recs, _ = serial_ledger
+    art = timeline.reconstruct(recs)
+    assert art is not None and art["groups"] > 2
+    groups = [r for r in recs if r["kind"] == "group"]
+    # Serial contract: group N+1's staging starts only after N retired.
+    for a, b in zip(groups, groups[1:]):
+        assert b["staged_at"] >= a["retired_at"], (a, b)
+    # So the staging lane can never run concurrently with the device lane.
+    assert art["overlap_s"].get("staging+device", 0.0) == 0.0
+    # Device busy == sum of per-group device intervals (nothing merged:
+    # no two groups were ever in flight together).
+    per_group = sum(g["token_ready_at"] - g["dispatched_at"]
+                    for g in groups)
+    assert art["lane_busy_s"]["device"] == pytest.approx(per_group,
+                                                         abs=1e-4)
+    # Every gap the device sat idle is attributed to a host lane (reader/
+    # staging/retire) — "idle" (nothing measured) would mean the timeline
+    # lost track of the serial loop's own work.
+    for gap in art["device_idle"]["gaps"]:
+        assert gap["blocking"] in ("reader", "staging", "retire"), gap
+
+
+def test_pipelined_window_overlaps_lanes(piped_ledger):
+    """inflight_groups>1: the reader lane measurably overlaps the device
+    lane (prefetch + the window run ahead) — the measured counterpart of
+    overlap_fraction the scalar stats could only assert indirectly."""
+    recs, _ = piped_ledger
+    art = timeline.reconstruct(recs)
+    assert art is not None
+    assert art["overlap_s"].get("reader+device", 0.0) > 0.0
+    assert art["bottleneck"]["resource"] in timeline.LANES
+    assert art["bottleneck"]["projected_saving_s"] <= art["span_s"]
+
+
+def test_group_record_overhead_under_1ms(tmp_path):
+    """ISSUE 7 acceptance: per-group recording is host-side timestamping
+    only — the full emission path (_group_life stamps + registry + ledger
+    JSONL append) must average far under 1 ms per group."""
+    import numpy as np
+
+    class _B:  # the two attributes _group_life reads off a Batch
+        def __init__(self, step):
+            self.step = step
+            self.lengths = np.array([1024, 1024], np.int64)
+
+    led = str(tmp_path / "overhead.jsonl")
+    n = 300
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        t0 = time.perf_counter()
+        for i in range(n):
+            life = executor._group_life([_B(i)], time.perf_counter(),
+                                        int(_B(i).lengths.sum()))
+            life["dispatched_at"] = life["staged_at"]
+            executor._group_record(tel, True, life,
+                                   token_ready_at=life["staged_at"] + 0.01,
+                                   retired_at=life["staged_at"] + 0.011,
+                                   wait_s=0.005)
+        dt = time.perf_counter() - t0
+    assert dt / n < 1e-3, f"{1e3 * dt / n:.3f} ms per group record"
+    assert len(list(obs.read_ledger(led, kind="group"))) == n
+
+
+# -- reconstruction on crafted records --------------------------------------
+
+def _crafted_records():
+    """The documented worked example: 4 groups, window depth 2,
+    reader-bound with two 0.2 s device-idle gaps (mirrors fixture04)."""
+    mk = lambda sf, sl, r, s, d, t, e, **kw: {
+        "run_id": "craft", "kind": "group", "step_first": sf,
+        "step_last": sl, "steps": sl - sf + 1, "group_bytes": 100,
+        "read_at": r, "staged_at": s, "dispatched_at": d,
+        "token_ready_at": t, "retired_at": e, "retire_wait_s": 0.1, **kw}
+    return [
+        {"run_id": "craft", "kind": "run_start", "ledger_version": 2},
+        mk(0, 1, 10.0, 10.1, 10.2, 10.6, 10.62),
+        mk(2, 3, 10.1, 10.3, 10.4, 11.0, 11.02),
+        mk(4, 5, 10.4, 11.1, 11.2, 11.6, 11.62),
+        mk(6, 7, 11.1, 11.72, 11.8, 12.0, 12.02, h2d_done_at=11.9),
+        {"run_id": "craft", "kind": "run_end", "bytes": 400},
+    ]
+
+
+def test_crafted_overlap_matrix_and_verdict():
+    """The overlap matrix, idle attribution and critical-path verdict of a
+    hand-built overlapped window, checked against the arithmetic done on
+    paper (docs/observability.md's worked example)."""
+    art = timeline.reconstruct(_crafted_records())
+    assert art["groups"] == 4
+    assert round(art["span_s"], 4) == 2.02
+    # Lane busy seconds.
+    assert round(art["lane_busy_s"]["reader"], 4) == 1.62
+    assert round(art["lane_busy_s"]["staging"], 4) == 0.38
+    assert round(art["lane_busy_s"]["device"], 4) == 1.4
+    assert round(art["lane_busy_s"]["retire"], 4) == 0.08
+    assert round(art["lane_busy_s"]["h2d"], 4) == 0.18
+    # The measured overlap matrix.
+    ov = {k: round(v, 4) for k, v in art["overlap_s"].items()}
+    assert ov["reader+device"] == 1.1
+    assert ov["reader+staging"] == 0.2
+    assert ov["staging+device"] == 0.1
+    assert ov["h2d+device"] == 0.1
+    assert ov["staging+h2d"] == 0.08
+    assert ov["reader+retire"] == 0.06
+    assert ov["device+retire"] == 0.02
+    # Device idle: two 0.2 s gaps, both opened blocked on the reader.
+    idle = art["device_idle"]
+    assert round(idle["total_s"], 4) == 0.4
+    assert [g["blocking"] for g in idle["gaps"]] == ["reader", "reader"]
+    assert [round(g["s"], 4) for g in idle["gaps"]] == [0.2, 0.2]
+    assert round(idle["blocked_on"]["reader"], 4) == 0.4
+    # Critical path: 0.28 s of the span is reader-exclusive — more than
+    # any other lane — so the reader is the bounding resource and an
+    # infinitely fast reader is worth exactly those seconds.
+    excl = {k: round(v, 4) for k, v in art["exclusive_s"].items()}
+    assert excl == {"reader": 0.28, "staging": 0.0, "h2d": 0.0,
+                    "device": 0.1, "retire": 0.02}
+    bn = art["bottleneck"]
+    assert bn["resource"] == "reader"
+    assert round(bn["projected_saving_s"], 4) == 0.28
+    assert round(bn["projected_span_s"], 4) == 1.74
+    assert round(bn["device_idle_s"], 4) == 0.4
+
+
+def test_reconstruct_requires_group_records():
+    """Pre-ISSUE-7 ledgers (steps only) degrade to None, not an error."""
+    recs = [{"run_id": "old", "kind": "run_start"},
+            {"run_id": "old", "kind": "step", "step_first": 0},
+            {"run_id": "old", "kind": "run_end"}]
+    assert timeline.reconstruct(recs) is None
+    assert timeline.to_chrome_trace(recs) is None
+
+
+def test_reconstruct_picks_one_run():
+    """Mixed-run ledgers reconstruct the requested run only (default: the
+    first run carrying group records)."""
+    recs = _crafted_records() + [
+        dict(g, run_id="other") for g in _crafted_records()[1:5]]
+    art = timeline.reconstruct(recs)
+    assert art["run_id"] == "craft" and art["groups"] == 4
+    art2 = timeline.reconstruct(recs, run_id="other")
+    assert art2["run_id"] == "other" and art2["groups"] == 4
+
+
+# -- forward compatibility ---------------------------------------------------
+
+def test_future_ledger_skips_unknown_kinds_and_fields():
+    """ISSUE 7 satellite: a future-versioned ledger (unknown kinds,
+    unknown fields, a future group-record shape missing today's core
+    fields) flows through read_ledger, the timeline reconstructor and the
+    trace exporter without error, surfacing what IS understood."""
+    path = os.path.join(FIXTURES, "future_ledger.jsonl")
+    recs = list(obs.read_ledger(path))
+    assert any(r["kind"] == "warp_stats" for r in recs)  # passed through
+    start = next(r for r in recs if r["kind"] == "run_start")
+    assert start["ledger_version"] == 99
+    art = timeline.reconstruct(recs)
+    # The well-formed group record reconstructs; the future-shaped one
+    # (teleported_at only) is skipped, not fatal.
+    assert art is not None and art["groups"] == 1
+    trace = timeline.to_chrome_trace(recs)
+    assert trace is not None and not trace_export.validate_trace(trace)
+
+
+# -- trace export -------------------------------------------------------------
+
+def test_chrome_trace_schema_and_structure():
+    """The exported trace is schema-valid and structured one-pid-per-lane,
+    one-tid-per-group, with paired flow events."""
+    trace = timeline.to_chrome_trace(_crafted_records())
+    assert trace_export.validate_trace(trace) == []
+    evs = trace["traceEvents"]
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert sorted(pnames.values()) == sorted(timeline.LANES)
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in slices)
+    dev_pid = next(p for p, n in pnames.items() if n == "device")
+    assert {e["tid"] for e in slices if e["pid"] == dev_pid} == {0, 2, 4, 6}
+    starts = {e["id"] for e in evs if e["ph"] == "s"}
+    ends = {e["id"] for e in evs if e["ph"] == "f"}
+    assert starts == ends == {0, 2, 4, 6}
+    assert trace["otherData"]["bottleneck"]["resource"] == "reader"
+    # Round-trips through JSON byte-identically.
+    assert json.loads(json.dumps(trace)) == trace
+
+
+def test_validate_trace_catches_breakage():
+    trace = timeline.to_chrome_trace(_crafted_records())
+    bad = json.loads(json.dumps(trace))
+    for ev in bad["traceEvents"]:
+        if ev["ph"] == "X":
+            del ev["dur"]
+            break
+    assert trace_export.validate_trace(bad)
+    assert trace_export.validate_trace({"traceEvents": "nope"})
+
+
+@pytest.mark.smoke
+def test_trace_export_cli_runs_without_jax(tmp_path):
+    """The CLI path is jax-free (the box reading forensics need not be the
+    box that produced them): a poisoned `jax` package on PYTHONPATH would
+    fail the run if anything imported it."""
+    poison = tmp_path / "poison" / "jax"
+    poison.mkdir(parents=True)
+    (poison / "__init__.py").write_text(
+        "raise ImportError('trace_export must stay jax-free')")
+    env = {**os.environ, "PYTHONPATH": str(tmp_path / "poison")}
+    out = str(tmp_path / "trace.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         os.path.join(FIXTURES, "mini_ledger.jsonl"), "--out", out],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bottleneck reader" in proc.stdout
+    with open(out) as f:
+        trace = json.load(f)
+    assert trace_export.validate_trace(trace) == []
+    # --selftest under the same poison: the fixture gate itself is jax-free.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_trace_export_cli_declines_groupless_ledger(tmp_path):
+    led = tmp_path / "old.jsonl"
+    led.write_text('{"run_id": "x", "kind": "run_start"}\n'
+                   '{"run_id": "x", "kind": "step", "step_first": 0}\n')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         str(led)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "no group records" in proc.stderr
+
+
+# -- executor end-to-end: trace from a real run -------------------------------
+
+def test_real_run_exports_valid_trace(piped_ledger):
+    """Ledger from a real pipelined CPU run -> schema-valid Chrome trace
+    whose verdict names a real lane — the full ISSUE 7 path end to end."""
+    recs, _ = piped_ledger
+    trace = timeline.to_chrome_trace(recs)
+    assert trace is not None
+    assert trace_export.validate_trace(trace) == []
+    assert trace["otherData"]["bottleneck"]["resource"] in timeline.LANES
+    n_groups = sum(1 for r in recs if r["kind"] == "group")
+    assert trace["otherData"]["groups"] == n_groups

@@ -53,8 +53,10 @@ def run_step(out_path: str, name: str, cmd: list[str], env: dict,
     its own) and reported as failed."""
     log(out_path, f"running {name}: {' '.join(cmd)}")
     # Each bench step writes its run ledger next to its output capture, so
-    # a wedged window leaves per-step forensics (ledger + .flight.json)
-    # the next session can obs_report instead of a bare timeout line.
+    # a wedged window leaves per-step forensics (ledger + .flight.json +
+    # the .trace.json Perfetto export bench derives from the ledger's
+    # group records) the next session can obs_report / trace_export
+    # instead of a bare timeout line.
     env = {**env, "BENCH_LEDGER": out_path + f".{name}.ledger.jsonl"}
     with open(out_path + f".{name}.out", "w") as stdout_f:
         proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=stdout_f,
@@ -113,11 +115,17 @@ def main() -> int:
                 # (BENCH_INFLIGHT=1), so the first live window measures
                 # the window on/off delta directly.  Both rows keep the
                 # streamed post-phase — it IS the measurement — and both
-                # are A/B evidence (LAST_GOOD refuses the knob).
+                # are A/B evidence (LAST_GOOD refuses the knob).  Each
+                # row's ledger (BENCH_LEDGER, set per step above) now
+                # carries per-group lifecycle records, and bench exports
+                # a Perfetto trace + `bottleneck` verdict next to it
+                # (ISSUE 7): the first live window yields measured
+                # timelines — which resource bounded each arm, and where
+                # the device idled — not just two scalar ratios.
                 ("bench-zipf-pipeline", [sys.executable, "bench.py"],
-                 {**env, "BENCH_INFLIGHT": "4"}),
+                 {**env, "BENCH_INFLIGHT": "4", "BENCH_TRACE": "1"}),
                 ("bench-zipf-nopipeline", [sys.executable, "bench.py"],
-                 {**env, "BENCH_INFLIGHT": "1"}),
+                 {**env, "BENCH_INFLIGHT": "1", "BENCH_TRACE": "1"}),
                 # ISSUE 6 fused-map A/B: one kernel pass over raw chunk
                 # bytes (tokenize -> hash -> window compaction in VMEM, no
                 # token-plane round-trip) vs the shipped split path.  Each

@@ -14,10 +14,20 @@ Reads the JSONL run ledger the executor writes under ``--ledger``
 * anomalies: step-time spikes (elapsed > 3x the median step — recompiles
   and relay stalls look exactly like this), device memory growth across
   the run (leaked live arrays), retries, failures (with the flight-dump
-  path), checkpoint cadence, compile cost.
+  path), checkpoint cadence, compile cost;
+* the **timeline** section (ISSUE 7), when the ledger carries ``group``
+  lifecycle records: measured per-resource busy seconds, the device-idle
+  total with per-lane blame, the pairwise overlap matrix, and the
+  critical-path ``bottleneck`` verdict (bounding resource + projected
+  saving were it infinitely fast) — reconstructed by
+  ``mapreduce_tpu/obs/timeline.py``; ``tools/trace_export.py`` renders
+  the same records as a Perfetto-viewable trace.
 
 Deliberately jax-free and stdlib-only: a wedged TPU box, a laptop, or CI
-can all read the forensics of a run that happened somewhere else.
+can all read the forensics of a run that happened somewhere else (the
+timeline module is loaded by file path, not via the package).  Unknown
+record kinds and unknown fields pass through untouched (ledger forward
+compat): a future-versioned ledger still renders.
 
 Usage::
 
@@ -43,6 +53,33 @@ SPIKE_FACTOR = 3.0  # a step slower than 3x the median step is an anomaly
 SPIKE_FLOOR_S = 0.05  # ...unless everything is sub-noise fast
 MEM_GROWTH_FACTOR = 1.5  # first->last live-bytes ratio that flags growth
 MEM_GROWTH_FLOOR = 32 << 20  # ...and the absolute delta that makes it real
+
+_TIMELINE = None
+
+
+def _timeline_mod():
+    """The jax-free timeline reconstructor, loaded by file path from the
+    source tree (importing the package would pull config/jax); falls back
+    to the installed package, and to None when neither exists — the report
+    then simply has no timeline section."""
+    global _TIMELINE
+    if _TIMELINE is None:
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           os.pardir, "mapreduce_tpu", "obs", "timeline.py")
+        try:
+            if os.path.exists(src):
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location(
+                    "_mapreduce_tpu_obs_timeline", src)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _TIMELINE = mod
+            else:
+                from mapreduce_tpu.obs import timeline as _TIMELINE
+        except Exception:
+            _TIMELINE = False  # degraded: report without timelines
+    return _TIMELINE or None
 
 
 def read_ledger(path: str):
@@ -246,9 +283,18 @@ def analyze_run(records: list) -> dict:
     header = {k: start.get(k) for k in
               ("driver", "job", "devices", "chunk_bytes", "superstep",
                "backend", "map_impl", "merge_strategy", "input",
-               "retry")} if start else None
+               "retry", "ledger_version")} if start else None
     classification = classify(phases)
+    # Measured timeline (ISSUE 7): present only when the run carries
+    # `group` lifecycle records AND the reconstructor is loadable.
+    timeline = None
+    if any(r.get("kind") == "group" for r in records):
+        tl = _timeline_mod()
+        if tl is not None:
+            timeline = tl.reconstruct(records,
+                                      run_id=records[0].get("run_id"))
     return {
+        "timeline": timeline,
         "pipeline": pipeline,
         "overlap_fraction": (pipeline or {}).get("overlap_fraction"),
         "pipeline_flags": pipeline_flags(phases, pipeline),
@@ -325,6 +371,25 @@ def render_run(a: dict, out) -> None:
         if a.get("overlap_fraction") is not None:
             out.write(f"  overlap={a['overlap_fraction']:.2f}")
         out.write("\n")
+    tl = a.get("timeline")
+    if tl:
+        bn = tl["bottleneck"]
+        idle = tl["device_idle"]
+        out.write(f"  timeline: {tl['groups']} groups over "
+                  f"{tl['span_s']:.3f}s  device busy "
+                  f"{bn['device_busy_s']:.3f}s  idle {idle['total_s']:.3f}s")
+        if idle.get("blocked_on"):
+            blame = ", ".join(
+                f"{k} {v:.3f}s" for k, v in
+                sorted(idle["blocked_on"].items(), key=lambda kv: -kv[1]))
+            out.write(f" (blocked on: {blame})")
+        out.write("\n")
+        out.write(f"  bottleneck: {bn['resource']} — {bn['detail']}\n")
+        overlaps = {k: v for k, v in tl.get("overlap_s", {}).items() if v}
+        if overlaps:
+            out.write("  overlap: " + "  ".join(
+                f"{k}={v:.3f}s" for k, v in
+                sorted(overlaps.items(), key=lambda kv: -kv[1])[:6]) + "\n")
     for f in a.get("pipeline_flags", []):
         out.write(f"  PIPELINE {f['flag']}: {f['detail']}\n")
     for f in a.get("map_flags", []):
@@ -372,7 +437,7 @@ def selftest() -> int:
     ledger = os.path.join(fdir, "mini_ledger.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 3, f"fixture holds three runs, got {len(runs)}"
+    assert len(runs) == 4, f"fixture holds four runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -409,6 +474,29 @@ def selftest() -> int:
     assert not c["pipeline_flags"], c["pipeline_flags"]
     cflags = {f["flag"] for f in c["map_flags"]}
     assert cflags == {"fused-map-host-bound"}, cflags
+    # Runs 1-3 predate group records: no timeline section, by design.
+    assert a["timeline"] is None and c["timeline"] is None
+    # Run 4 (ISSUE 7): a pipelined run carrying `group` lifecycle records.
+    # Constructed reader-bound: two 0.2 s device-idle gaps both covered by
+    # the reader lane, and 0.28 s of the 2.02 s span is reader-exclusive —
+    # the timeline must name the reader as the critical path with exactly
+    # those measured seconds.
+    d = runs[3]
+    assert d["header"]["ledger_version"] == 2, d["header"]
+    tl = d["timeline"]
+    assert tl is not None and tl["groups"] == 4, tl
+    bn = tl["bottleneck"]
+    assert bn["resource"] == "reader", bn
+    assert round(bn["projected_saving_s"], 4) == 0.28, bn
+    assert round(tl["device_idle"]["total_s"], 4) == 0.4, tl["device_idle"]
+    assert [g["blocking"] for g in tl["device_idle"]["gaps"]] \
+        == ["reader", "reader"], tl["device_idle"]
+    assert round(tl["overlap_s"]["staging+device"], 4) == 0.1
+    assert round(tl["overlap_s"]["reader+device"], 4) == 1.1
+    assert round(tl["lane_busy_s"]["device"], 4) == 1.4
+    # The phase classifier agrees with the measured timeline here (both
+    # say the reader) — the timeline adds the HOW MUCH the deltas cannot.
+    assert d["classification"] == "read-bound", d["classification"]
     # The human renderer must run over all artifacts without raising.
     import io
 
@@ -416,6 +504,7 @@ def selftest() -> int:
     render_run(a, buf)
     render_run(b, buf)
     render_run(c, buf)
+    render_run(d, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert "ANOMALY step-time spike" in body
@@ -426,11 +515,26 @@ def selftest() -> int:
     assert "pipeline: inflight=4" in body
     assert "map: fused" in body
     assert "MAP fused-map-host-bound" in body
+    assert "timeline: 4 groups" in body
+    assert "bottleneck: reader" in body
+    assert "blocked on: reader 0.400s" in body
+    # Ledger forward compat (ISSUE 7 satellite): a future-versioned ledger
+    # with unknown kinds and unknown fields must analyze and render
+    # without error, and still surface the facts it does understand.
+    fruns = analyze(os.path.join(fdir, "future_ledger.jsonl"))
+    assert len(fruns) == 1, fruns
+    f = fruns[0]
+    assert f["header"]["ledger_version"] == 99, f["header"]
+    assert f["completed"] and f["steps"] == 1 and f["bytes"] == 1024
+    assert f["timeline"] is not None and f["timeline"]["groups"] == 1, \
+        "the malformed future group record must be skipped, not fatal"
+    render_run(f, io.StringIO())
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
           "1 memory-growth flag, "
           f"{len(a['pipeline_flags']) + len(b['pipeline_flags'])} "
-          f"pipeline flags, {len(c['map_flags'])} map flag)")
+          f"pipeline flags, {len(c['map_flags'])} map flag, "
+          f"timeline bottleneck={bn['resource']}, future-ledger ok)")
     return 0
 
 

@@ -18,4 +18,9 @@
 # test runs.
 cd "$(dirname "$0")/.." || exit 1
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-models --min-severity error || { echo "TIER1: costcheck gate FAILED"; exit 1; }
+# Jax-free reporting-path gates (ISSUE 7 satellite): the obs_report and
+# trace_export selftests run against the checked-in ledger fixtures —
+# the whole ledger -> timeline -> Perfetto-trace path is certified before
+# a single test runs, in seconds.
+timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_export selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

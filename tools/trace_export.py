@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""Render a run ledger's ``group`` lifecycle records as Chrome trace-event
+JSON, viewable in Perfetto (ui.perfetto.dev) or ``chrome://tracing``
+(ISSUE 7).
+
+The executor stamps every superstep group's lifecycle and writes one
+``group`` ledger record per retired group; ``mapreduce_tpu/obs/timeline.py``
+reconstructs those into per-resource lanes, and this tool serializes the
+same reconstruction as a trace: one **pid per resource lane** (reader /
+staging / h2d / device / retire), one **tid per group**, flow arrows for
+the dispatch -> token-ready hand-off, and instant markers on the device
+lane for every attributed idle gap.  The ``otherData.bottleneck`` dict
+carries the critical-path verdict, so the trace file alone answers "what
+bounded this run".
+
+Usage::
+
+    python tools/trace_export.py /path/run.jsonl                  # -> run.jsonl.trace.json
+    python tools/trace_export.py /path/run.jsonl --out t.json
+    python tools/trace_export.py /path/run.jsonl --stdout
+    python tools/trace_export.py --selftest                       # fixture-driven
+
+Deliberately jax-free and stdlib-only (like ``obs_report.py``): the
+timeline module is loaded by file path from the source tree, falling back
+to the installed package, so a laptop or CI box can render the forensics
+of a run that happened on a TPU host.  ``--selftest`` exports the
+checked-in pipelined fixture (``tools/fixtures/mini_ledger.jsonl``) and
+schema-checks the result; it is wired into ``tools/tier1.sh`` and
+``tools/smoke.sh``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+try:
+    # Sibling tool, same stdlib-only constraint: owns the one JSONL reader
+    # and the one by-path loader of obs/timeline.py, so the forward-compat
+    # line-skipping rules and the source-vs-installed fallback live in
+    # exactly one place.
+    import obs_report
+finally:
+    sys.path.pop(0)
+
+read_ledger = obs_report.read_ledger
+
+
+def timeline_mod():
+    """The jax-free reconstructor (see ``obs_report._timeline_mod``);
+    unlike the report — which degrades to "no timeline section" — this
+    tool has nothing to do without it, so absence is an error."""
+    tl = obs_report._timeline_mod()
+    if tl is None:
+        raise RuntimeError(
+            "timeline module unavailable: neither the source tree's "
+            "mapreduce_tpu/obs/timeline.py nor an installed mapreduce_tpu "
+            "package was found")
+    return tl
+
+
+# -- schema validation -------------------------------------------------------
+
+_PHASES = {"X", "M", "s", "f", "i"}
+
+
+def validate_trace(trace) -> list:
+    """Structural validation of a Chrome trace-event object: returns a list
+    of problems (empty = valid).  Checks the subset of the trace-event
+    format this tool emits — enough for Perfetto/chrome://tracing to load
+    the file: every event has a known phase and an int pid; timed events
+    carry non-negative ``ts`` (and ``dur`` for complete events); every pid
+    used by a slice has a ``process_name`` metadata event; flow starts and
+    ends pair up by id."""
+    errs = []
+    if not isinstance(trace, dict) or \
+            not isinstance(trace.get("traceEvents"), list):
+        return ["top level must be an object with a traceEvents list"]
+    named_pids, used_pids = set(), set()
+    flow = {"s": set(), "f": set()}
+    for i, ev in enumerate(trace["traceEvents"]):
+        if not isinstance(ev, dict):
+            errs.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errs.append(f"event {i}: unknown phase {ph!r}")
+            continue
+        if not isinstance(ev.get("pid"), int):
+            errs.append(f"event {i}: pid must be an int")
+            continue
+        if ph == "M":
+            if not isinstance(ev.get("name"), str):
+                errs.append(f"event {i}: metadata without a name")
+            elif ev["name"] == "process_name":
+                named_pids.add(ev["pid"])
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool) or ts < 0:
+            errs.append(f"event {i}: ts must be a non-negative number")
+        if not isinstance(ev.get("name"), str):
+            errs.append(f"event {i}: missing name")
+        used_pids.add(ev["pid"])
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                    or dur < 0:
+                errs.append(f"event {i}: X event needs non-negative dur")
+        elif ph in ("s", "f"):
+            if ev.get("id") is None:
+                errs.append(f"event {i}: flow event without id")
+            else:
+                flow[ph].add(ev["id"])
+    for pid in sorted(used_pids - named_pids):
+        errs.append(f"pid {pid} has slices but no process_name metadata")
+    if flow["s"] != flow["f"]:
+        errs.append(f"unmatched flow ids: starts {sorted(flow['s'])} vs "
+                    f"ends {sorted(flow['f'])}")
+    return errs
+
+
+def export(ledger_path: str, run_id=None):
+    """Ledger file -> (trace dict or None, timeline artifact or None)."""
+    tl = timeline_mod()
+    records = read_ledger(ledger_path)
+    return tl.to_chrome_trace(records, run_id), tl.reconstruct(records,
+                                                               run_id)
+
+
+# -- selftest ----------------------------------------------------------------
+
+def selftest() -> int:
+    """Export the checked-in pipelined fixture and assert the trace's
+    load-bearing facts (schema validity, lane/pid structure, flow pairing,
+    the bottleneck verdict riding along)."""
+    tl = timeline_mod()
+    ledger = os.path.join(HERE, "fixtures", "mini_ledger.jsonl")
+    trace, art = export(ledger)
+    assert trace is not None and art is not None, \
+        "fixture must carry group records (pipelined run fixture04)"
+    errs = validate_trace(trace)
+    assert not errs, f"schema errors: {errs}"
+    evs = trace["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    # One pid per lane, in lane order.
+    assert sorted(pnames.values()) == sorted(tl.LANES), pnames
+    # One tid per group on the device lane.
+    dev_pid = next(p for p, n in pnames.items() if n == "device")
+    dev_tids = {e["tid"] for e in slices if e["pid"] == dev_pid}
+    assert dev_tids == {0, 2, 4, 6}, dev_tids  # step_first of each group
+    # The fixture's construction: 4 groups, reader-bound, 0.4 s device
+    # idle across two gaps both attributed to the reader.
+    assert art["groups"] == 4
+    bn = art["bottleneck"]
+    assert bn["resource"] == "reader", bn
+    assert round(bn["projected_saving_s"], 4) == 0.28, bn
+    assert round(art["device_idle"]["total_s"], 4) == 0.4
+    assert [g["blocking"] for g in art["device_idle"]["gaps"]] \
+        == ["reader", "reader"]
+    assert round(art["overlap_s"]["staging+device"], 4) == 0.1
+    assert trace["otherData"]["bottleneck"]["resource"] == "reader"
+    # Flow arrows: one dispatch->token_ready pair per group.
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == len(ends) == 4, (len(starts), len(ends))
+    # Idle-gap instant markers land on the device lane.
+    gaps = [e for e in evs if e["ph"] == "i"]
+    assert len(gaps) == 2 and all(e["pid"] == dev_pid for e in gaps)
+    # Round-trip: the emitted JSON parses back identically.
+    assert json.loads(json.dumps(trace)) == trace
+    # Forward compat: the future-versioned fixture must export (or decline
+    # with None) without raising, never error.
+    future = os.path.join(HERE, "fixtures", "future_ledger.jsonl")
+    ftrace, fart = export(future)
+    assert fart is not None and fart["groups"] >= 1, fart
+    assert not validate_trace(ftrace)
+    print(f"trace_export selftest ok ({len(slices)} slices, "
+          f"{len(starts)} flows, {len(gaps)} idle markers, "
+          f"bottleneck={bn['resource']})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="export a mapreduce_tpu run ledger as Chrome "
+                    "trace-event JSON (Perfetto / chrome://tracing)")
+    ap.add_argument("ledger", nargs="?", help="JSONL run-ledger path")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: <ledger>.trace.json)")
+    ap.add_argument("--run", default=None,
+                    help="run_id to export (default: first run with "
+                         "group records)")
+    ap.add_argument("--stdout", action="store_true",
+                    help="write the trace JSON to stdout instead of a file")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run against the checked-in fixtures and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.ledger:
+        ap.error("a ledger path (or --selftest) is required")
+    trace, art = export(args.ledger, args.run)
+    if trace is None:
+        print("no group records found (pre-ISSUE-7 ledger, or the run "
+              "never retired a group) — nothing to export",
+              file=sys.stderr)
+        return 1
+    errs = validate_trace(trace)
+    if errs:  # a bug here must fail loudly, not ship a broken trace
+        for e in errs:
+            print(f"schema error: {e}", file=sys.stderr)
+        return 2
+    if args.stdout:
+        json.dump(trace, sys.stdout)
+        print()
+    else:
+        out = args.out or args.ledger + ".trace.json"
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        bn = art["bottleneck"]
+        print(f"wrote {out}: {art['groups']} groups over "
+              f"{art['span_s']:.3f}s, device idle "
+              f"{art['device_idle']['total_s']:.3f}s, bottleneck "
+              f"{bn['resource']} (projected saving "
+              f"{bn['projected_saving_s']:.3f}s) — open in ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
