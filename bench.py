@@ -795,6 +795,36 @@ def main() -> int:
                 except Exception as e:  # noqa: BLE001 — advisory only
                     print(f"[bench] trace export skipped ({e!r})",
                           file=sys.stderr)
+            # Data-plane summary (ISSUE 8): the timed pass's `data` record
+            # + its health classification ride BENCH JSON, so the A/B rows
+            # carry the corpus-and-backend shape signals (skew, spill
+            # fallbacks, window occupancy) the autotuner needs next to
+            # the bottleneck verdict.  Advisory and LAST_GOOD-neutral
+            # (the value-aware ledger tracks only its named metrics).
+            try:
+                from mapreduce_tpu.obs import datahealth as dh_mod
+
+                recs = list(obs.read_ledger(streamed_ledger))
+                # Keyed to THIS pass's run_id: BENCH_LEDGER may point at an
+                # appended multi-run file (benchwatch reuses one path per
+                # suite step), and the summary must describe the timed
+                # pass, not whichever run landed first.
+                data_rec = dh_mod.data_record(recs, run_id=tel.run_id)
+                if data_rec is not None:
+                    result["data"] = {
+                        k: v for k, v in data_rec.items()
+                        if k not in ("ts", "run_id", "kind")}
+                    health = dh_mod.classify(result["data"])
+                    result["data_health"] = health
+                    _log("data health: "
+                         f"{health['verdict']} (top_mass="
+                         f"{health['signals'].get('top_mass')}, "
+                         "fallback_frac="
+                         f"{health['signals'].get('fallback_frac')})",
+                         wall0)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                print(f"[bench] data summary skipped ({e!r})",
+                      file=sys.stderr)
         # Registry DELTA over the timed streamed pass (the registry is
         # process-global, so an absolute snapshot would fold in the
         # headline + warm-up activity): steps/dispatches/prefetches and

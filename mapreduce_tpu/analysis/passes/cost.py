@@ -48,7 +48,17 @@ REGRESSION_TOLERANCE = 0.20
 # Fused-map registry models gated against their split-path twin's baseline
 # (same chunk geometry, Config.map_impl the only delta — see
 # models.FUSED_ANALYSIS_CONFIG).
-_SPLIT_COUNTERPART = {"wordcount_fused": "wordcount_pallas"}
+_SPLIT_COUNTERPART = {"wordcount_fused": "wordcount_pallas",
+                      "wordcount_fused_telemetry": "wordcount_telemetry"}
+
+# Data-stats-instrumented registry models gated against their
+# UNINSTRUMENTED twin's baseline (same config, Engine data_stats the only
+# delta — ISSUE 8): observability must never silently regress the cost
+# certificates, so the instrumented step's effective_input_passes may move
+# at most TELEMETRY_TOLERANCE from the plain program's.
+_PLAIN_COUNTERPART = {"wordcount_telemetry": "wordcount_pallas",
+                      "wordcount_fused_telemetry": "wordcount_fused"}
+TELEMETRY_TOLERANCE = 0.01
 
 _BASELINES_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "baselines")
@@ -110,8 +120,72 @@ class CostPass:
         out.extend(self._sort_findings(ctx, report))
         out.extend(self._baseline_findings(ctx, report))
         out.extend(self._fused_gate_findings(ctx, report))
+        out.extend(self._telemetry_gate_findings(ctx, report))
         ctx.artifacts["cost"] = report
         return out
+
+    # -- telemetry-overhead gate (ISSUE 8) -------------------------------
+
+    def _telemetry_gate_findings(self, ctx, report) -> list[core.Finding]:
+        """An instrumented (data-stats) model must price within
+        ``TELEMETRY_TOLERANCE`` of its uninstrumented twin's checked-in
+        baseline — observability that silently grows the HBM bill would
+        invalidate every cost certificate downstream of it."""
+        plain_model = _PLAIN_COUNTERPART.get(ctx.model)
+        passes = report.get("effective_input_passes")
+        if plain_model is None or passes is None:
+            return []
+        plain = load_baseline(plain_model, ctx.baselines_dir)
+        if plain is None:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"uninstrumented counterpart {plain_model!r} has "
+                         "no cost baseline: the telemetry overhead cannot "
+                         "be gated"),
+                hint=f"regenerate with `python -m mapreduce_tpu.analysis "
+                     f"{plain_model} --write-baselines` and commit the JSON")]
+        plain_raw = plain.get("effective_input_passes")
+        if not isinstance(plain_raw, (int, float)) or plain_raw <= 0 \
+                or plain.get("traced_chunk_bytes") \
+                != report["traced_chunk_bytes"]:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"counterpart {plain_model!r} baseline is not "
+                         f"comparable (passes={plain_raw!r}, chunk="
+                         f"{plain.get('traced_chunk_bytes')!r} vs "
+                         f"{report['traced_chunk_bytes']}): the telemetry "
+                         "overhead cannot be gated"),
+                hint="keep the twin configs on the same chunk geometry and "
+                     "regenerate the baseline")]
+        plain_ref = float(plain_raw)
+        overhead = (passes - plain_ref) / plain_ref
+        report["telemetry_overhead"] = {
+            "plain_model": plain_model,
+            "plain_effective_input_passes": plain_ref,
+            "instrumented_effective_input_passes": passes,
+            "overhead_frac": round(overhead, 5),
+            "tolerance": TELEMETRY_TOLERANCE}
+        if abs(overhead) > TELEMETRY_TOLERANCE:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"data-stats instrumentation moves "
+                         f"effective_input_passes {overhead:+.2%} "
+                         f"({passes:.2f} vs {plain_ref:.2f} "
+                         f"{plain_model}), past the "
+                         f"{TELEMETRY_TOLERANCE:.0%} gate: observability "
+                         "is regressing the cost certificates"),
+                hint="the stats path grew real HBM traffic — keep the "
+                     "counters to predicates the map already computes and "
+                     "capacity-sized gauge reductions")]
+        return [core.Finding(
+            severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+            hook="step",
+            message=(f"telemetry overhead certified: {passes:.2f} vs "
+                     f"{plain_ref:.2f} uninstrumented "
+                     f"({overhead:+.3%}, gate {TELEMETRY_TOLERANCE:.0%})"))]
 
     # -- the 2.6-3.4-passes artifact ------------------------------------
 

@@ -120,7 +120,12 @@ def trace_engine(job: Any, mesh) -> dict:
     out: dict[str, Any] = {}
     axes = tuple(mesh.axis_names)
     try:
-        eng = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0])
+        # ``analysis_data_stats`` (registry: the *_telemetry models): trace
+        # the INSTRUMENTED step — data-plane counters returned next to the
+        # state (ISSUE 8) — so the cost/host-sync passes certify exactly
+        # the program telemetered runs dispatch.
+        eng = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
+                     data_stats=getattr(job, "analysis_data_stats", False))
     except Exception as e:
         f = TraceFailure.of("engine", e)
         return {"step": f, "finish": f}

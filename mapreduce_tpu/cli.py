@@ -191,18 +191,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace (XProf/Perfetto) to DIR")
     p.add_argument("--ledger", default=None, metavar="PATH",
-                   help="with --stream: append a JSONL run ledger to PATH — "
-                        "one record per step/superstep carrying phase "
-                        "timings (read_wait/stage/dispatch), byte counts, "
-                        "device memory stats, compile events and retries; "
-                        "a failed run also dumps flight-recorder forensics "
-                        "to PATH.flight.json. Summarize with "
+                   help="append a JSONL run ledger to PATH. Streamed runs "
+                        "record one step + one group record per dispatch "
+                        "group (phase timings, bytes, device memory, "
+                        "compile events, lifecycle stamps, data-plane "
+                        "counters) plus a per-run data summary; a failed "
+                        "run also dumps flight-recorder forensics to "
+                        "PATH.flight.json. Batch (non---stream) runs emit "
+                        "run_start / data / run_end. Summarize with "
                         "tools/obs_report.py")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
-                   help="with --stream: write the end-of-run metrics-"
-                        "registry snapshot (executor/reader/checkpoint/"
-                        "collective counters, gauges, histograms) as JSON "
-                        "to PATH")
+                   help="write the end-of-run metrics-registry snapshot "
+                        "(executor/reader/checkpoint/collective/data "
+                        "counters, gauges, histograms) as JSON to PATH")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
                    help="'cpu' forces the run onto the host CPU even when the "
                         "environment pins JAX to an accelerator (equivalent "
@@ -290,6 +291,9 @@ def _grep_main(args, paths, data, config, input_bytes: int,
     kw = dict(config=config, syntax=syntax, checkpoint_path=args.checkpoint,
               checkpoint_every=args.checkpoint_every if args.checkpoint else 0,
               retry=args.retry, telemetry=telemetry)
+    batch_tel = telemetry if not args.stream else None
+    if batch_tel is not None:
+        _batch_run_start(batch_tel, "grep", paths, config, input_bytes)
     t0 = time.perf_counter()
     try:
         with profiling.trace(args.profile):
@@ -311,6 +315,10 @@ def _grep_main(args, paths, data, config, input_bytes: int,
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0
+    if batch_tel is not None:
+        batch_tel.ledger_write("run_end", bytes=input_bytes,
+                               words=sum(r.matches for r in results),
+                               elapsed_s=round(elapsed, 6))
 
     out = sys.stdout
     multi = len(results) > 1
@@ -348,6 +356,9 @@ def _sample_main(args, paths, data, config, input_bytes: int,
     from mapreduce_tpu.models import sample as sample_mod
     from mapreduce_tpu.runtime import profiling
 
+    batch_tel = telemetry if not args.stream else None
+    if batch_tel is not None:
+        _batch_run_start(batch_tel, "sample", paths, config, input_bytes)
     t0 = time.perf_counter()
     try:
         with profiling.trace(args.profile):
@@ -363,6 +374,10 @@ def _sample_main(args, paths, data, config, input_bytes: int,
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0
+    if batch_tel is not None:
+        batch_tel.ledger_write("run_end", bytes=input_bytes,
+                               words=result.total,
+                               elapsed_s=round(elapsed, 6))
 
     out = sys.stdout
     display = _decode(result.tokens)
@@ -403,10 +418,6 @@ def main(argv: list[str] | None = None) -> int:
                      "(--distinct-sketch / --count-sketch / --estimate)")
     if args.checkpoint and not args.stream:
         parser.error("--checkpoint requires --stream")
-    if (args.ledger or args.metrics_out) and not args.stream:
-        # Honest failure beats a flag silently ignored: telemetry records
-        # the streaming executor's steps; the single-buffer path has none.
-        parser.error("--ledger/--metrics-out require --stream")
     if args.retry and not args.stream:
         parser.error("--retry requires --stream (the non-stream path has no "
                      "step dispatch to retry)")
@@ -589,11 +600,41 @@ def main(argv: list[str] | None = None) -> int:
             tel.close()
 
 
+def _resolved_backend_name(config) -> str:
+    """The backend a run will actually use, for ledger records: 'auto'
+    must never reach the ledger (consumers key data records on the real
+    map path), but backend resolution needs jax — degrade to the raw
+    string rather than fail a telemetry write."""
+    try:
+        return config.resolved_backend()
+    except Exception:
+        return config.backend
+
+
+def _batch_run_start(tel, job: str, paths, config, input_bytes: int) -> None:
+    """Telemetered BATCH (non---stream) runs emit a run_start up front
+    (ISSUE 8 satellite: --ledger no longer requires --stream): the
+    single-buffer path has no step dispatches, so the ledger carries
+    run_start, a result-derived `data` record, and run_end — enough for
+    obs_report/--compare, and a crash leaves the honest run_start-only
+    trail."""
+    tel.ledger_write("run_start", driver="single_buffer", job=job,
+                     devices=1, chunk_bytes=input_bytes,
+                     superstep=1, backend=_resolved_backend_name(config),
+                     map_impl=config.map_impl,
+                     merge_strategy="none", input=list(paths),
+                     resume_step=0, resume_offset=0, retry=0)
+
+
 def _wordcount_main(args, paths, data, config, input_bytes: int,
                     telemetry=None) -> int:
     """Default mode: word counts (the reference's contract)."""
     from mapreduce_tpu.runtime import profiling
 
+    batch_tel = telemetry if not args.stream else None
+    if batch_tel is not None:
+        job = f"ngram{args.ngram}" if args.ngram > 1 else "wordcount"
+        _batch_run_start(batch_tel, job, paths, config, input_bytes)
     t0 = time.perf_counter()
     try:
         with profiling.trace(args.profile):
@@ -620,6 +661,24 @@ def _wordcount_main(args, paths, data, config, input_bytes: int,
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0
+    if batch_tel is not None:
+        # Result-derived data record: the batch path runs one jitted
+        # program over the whole buffer, so the data-plane story IS the
+        # result's accounting (tokens, dropped, distinct, top count).
+        batch_tel.ledger_write(
+            "data", groups=1, chunks=1,
+            backend=_resolved_backend_name(config),
+            map_impl=config.map_impl,
+            capacity=config.table_capacity, tokens=result.total,
+            dropped_tokens=result.dropped_count,
+            dropped_uniques=result.dropped_uniques,
+            table_valid=len(result.words),
+            top_count=max(result.counts, default=0),
+            table_occupancy=round(
+                len(result.words) / max(config.table_capacity, 1), 4))
+        batch_tel.ledger_write("run_end", bytes=input_bytes,
+                               words=result.total,
+                               elapsed_s=round(elapsed, 6))
 
     if args.top_k and not args.stream:  # stream mode already applied top-k
         from mapreduce_tpu.models.wordcount import apply_top_k

@@ -114,6 +114,35 @@ def _wordcount_fused(config: Config):
     return WordCountJob(FUSED_ANALYSIS_CONFIG)
 
 
+def _instrumented(job):
+    """Mark a job so ``analysis.trace.trace_engine`` builds the Engine in
+    data-stats mode (ISSUE 8): the traced step program is the INSTRUMENTED
+    one telemetered runs dispatch — map counters + state gauges returned
+    next to the state — so the hbm-cost pass prices exactly what
+    observability costs (ERROR-gated within 1% of the uninstrumented
+    twin's baseline) and the host-sync pass certifies the stats path adds
+    no host coupling."""
+    job.analysis_data_stats = True
+    return job
+
+
+def _wordcount_telemetry(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config (see _wordcount_pallas): the data-stats twin of the
+    # shipped stable2 pallas program, priced against it at 1%.
+    del config
+    return _instrumented(WordCountJob(PALLAS_ANALYSIS_CONFIG))
+
+
+def _wordcount_fused_telemetry(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config: the data-stats twin of the fused map program.
+    del config
+    return _instrumented(WordCountJob(FUSED_ANALYSIS_CONFIG))
+
+
 _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount": _wordcount,
     "grep": _grep,
@@ -123,6 +152,8 @@ _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount_radix": _wordcount_radix,
     "wordcount_pallas": _wordcount_pallas,
     "wordcount_fused": _wordcount_fused,
+    "wordcount_telemetry": _wordcount_telemetry,
+    "wordcount_fused_telemetry": _wordcount_fused_telemetry,
 }
 
 

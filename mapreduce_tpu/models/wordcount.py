@@ -24,6 +24,7 @@ import numpy as np
 
 from mapreduce_tpu import constants
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.ops import datastats
 from mapreduce_tpu.ops import sketch as sketch_ops
 from mapreduce_tpu.ops import table as table_ops
 from mapreduce_tpu.ops import tokenize as tok_ops
@@ -112,18 +113,59 @@ class SeamedUpdate(NamedTuple):
 
 
 def _map_stream(chunk: jax.Array, config: Config, capacity: int,
-                pos_hi: jax.Array | int = 0, split_seam: bool = False):
+                pos_hi: jax.Array | int = 0, split_seam: bool = False,
+                with_stats: bool = False):
     """Tokenize one buffer with the configured backend and build its table.
 
     With ``split_seam`` (streamed stable2 only) the result is a
     :class:`SeamedUpdate` whose seam table the caller folds at its next
     merge; otherwise a single fully-folded :class:`CountTable`.
+
+    With ``with_stats`` (ISSUE 8: the telemetered streamed path) the
+    result is ``(update, ops.datastats.DataStats)`` — the chunk's
+    data-plane counters (overlong/rescued/dropped, spill-fallback and
+    rescue-escalation cond branches taken, spill rows) surfaced as tiny
+    uint32 scalars the executor fetches at group retirement.  The update
+    itself is BIT-IDENTICAL to the plain path: the counters read
+    predicates the map already computes (``overlong``, ``spill``, the
+    rescue pass's own clamped count) and the built table's ``dropped_*``
+    scalars; with ``with_stats=False`` (the default, and every
+    non-telemetered caller) the traced program is unchanged.
     """
     if split_seam and (config.sort_mode != "stable2"
                        or config.resolved_backend() != "pallas"
                        or not config.resolved_compact_slots):
         raise ValueError("split_seam requires the pallas stable2 compact "
                          "path (the only producer of a separate seam table)")
+    # ``ret`` pairs every aggregation return with its per-chunk rescued
+    # count when stats are on (threaded through the same lax.cond branches
+    # the tables take, so both modes keep one control structure); the
+    # plain mode returns tables alone, bit-for-bit as before.
+    if with_stats:
+        ret = lambda t, rescued: (t, rescued)
+    else:
+        ret = lambda t, rescued: t
+    zero_u32 = jnp.zeros((), jnp.uint32)
+
+    def assemble(res, overlong, spill):
+        """Pair the final update with its chunk DataStats (stats mode)."""
+        if not with_stats:
+            return res
+        update, rescued = res
+        tbl = update.batch if isinstance(update, SeamedUpdate) else update
+        rescue_on = bool(config.rescue_slots)
+        tiered = config.rescue_slots_max > config.rescue_slots > 0
+        stats = datastats.map_stats(
+            overlong=overlong, rescued=rescued,
+            spill=spill if spill is not None else 0,
+            fallback=(spill != 0) if spill is not None else 0,
+            invoked=(overlong > 0) if rescue_on else 0,
+            escalated=(overlong > jnp.uint32(config.rescue_slots))
+            if tiered else 0,
+            dropped_tokens=tbl.dropped_count,
+            dropped_uniques=tbl.dropped_uniques)
+        return update, stats
+
     if config.resolved_backend() == "pallas":
         from mapreduce_tpu.ops import rescue as rescue_ops
         from mapreduce_tpu.ops.pallas import tokenize as pallas_tok
@@ -157,9 +199,10 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 # overlong run); the clamp bounds any future kernel drift
                 # that double-emits a poison to an accounting error instead
                 # of a silent uint32 wrap of dropped_count to ~2**32.
-                residual = overlong - jnp.minimum(rescued, overlong)
-                return accounted(table_ops.merge(t, rt, capacity=capacity),
-                                 residual)
+                ok = jnp.minimum(rescued, overlong)
+                return ret(accounted(table_ops.merge(t, rt,
+                                                     capacity=capacity),
+                                     overlong - ok), ok)
 
             def with_rescue(_):
                 r1 = config.rescue_slots
@@ -171,7 +214,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 return pass_with(rescue_packed)
 
             return jax.lax.cond(overlong > 0, with_rescue,
-                                lambda _: accounted(t, overlong), None)
+                                lambda _: ret(accounted(t, overlong),
+                                              zero_u32), None)
 
         # The spill-fallback / non-compact aggregation must not use stable2:
         # pair-layout streams are NOT position-ordered (rows interleave
@@ -194,7 +238,7 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 rescue_slots=config.rescue_slots_max,
                 sort_impl=config.sort_impl)
             if not config.rescue_slots:
-                return accounted(built, overlong)
+                return ret(accounted(built, overlong), zero_u32)
             t, rescue_packed = built
             return rescued_table(t, rescue_packed, overlong)
 
@@ -231,6 +275,7 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 min(capacity,
                     _seam_table_cap(config.pallas_max_token)),
                 pos_hi=pos_hi)
+            resc = zero_u32
             if not config.rescue_slots:
                 t = accounted(built, overlong)
             else:
@@ -255,10 +300,11 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 # where seam poisons ride the big sort inside one budget).
                 combined = jax.lax.sort(
                     jnp.concatenate([col_rescue, sp]))[:col_rescue.shape[0]]
-                t = rescued_table(t, combined, overlong)
+                res = rescued_table(t, combined, overlong)
+                t, resc = res if with_stats else (res, zero_u32)
             if split_seam:
-                return SeamedUpdate(batch=t, seam=seam_tbl)
-            return table_ops.merge(t, seam_tbl, capacity=capacity)
+                return ret(SeamedUpdate(batch=t, seam=seam_tbl), resc)
+            return ret(table_ops.merge(t, seam_tbl, capacity=capacity), resc)
 
         def seamed(t):
             """Match the split-seam pytree for paths with no seam table to
@@ -272,23 +318,41 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                         _seam_table_cap(config.pallas_max_token))))
             return t
 
-        def full_path(_):
+        def seamed_ret(res):
+            """``seamed`` lifted over the (table, rescued) pairing."""
+            if with_stats:
+                t, resc = res
+                return seamed(t), resc
+            return seamed(res)
+
+        def full_tok(_):
+            """Full-resolution split path, also reporting its overlong
+            scalar (the non-compact entry's stats need it; the cond
+            branch below drops it)."""
             col, seam, overlong = pallas_tok.tokenize_split(
                 chunk, max_token_bytes=config.pallas_max_token)
-            return seamed(aggregate(col, seam, overlong))
+            return seamed_ret(aggregate(col, seam, overlong)), overlong
+
+        def full_path(_):
+            return full_tok(_)[0]
 
         if config.map_impl == "fused":
-            def fused_full(_):
+            def fused_full_tok(_):
                 # Spill fallback = the SAME fused kernel in pair mode
                 # (full resolution, exact).  Pair-layout streams interleave
                 # lanes, so first occurrence needs the third sort key.
                 stream, overlong, _sp = pallas_tok.tokenize_fused(
                     chunk, max_token_bytes=config.pallas_max_token)
-                return seamed(aggregate_stream(stream, overlong,
-                                               concat_sort_mode))
+                return seamed_ret(aggregate_stream(stream, overlong,
+                                                   concat_sort_mode)), \
+                    overlong
+
+            def fused_full(_):
+                return fused_full_tok(_)[0]
 
             if not config.resolved_compact_slots:
-                return fused_full(None)
+                res, overlong = fused_full_tok(None)
+                return assemble(res, overlong, None)
             lane_major = config.sort_mode == "stable2"
             stream, overlong, spill = pallas_tok.tokenize_fused(
                 chunk, compact_slots=config.resolved_compact_slots,
@@ -299,13 +363,15 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             # (cross-seam tokens land in their start-position slot), so the
             # stable2 tie-order contract holds over the single stream.
             mode = "stable2" if lane_major else concat_sort_mode
-            return jax.lax.cond(
+            return assemble(jax.lax.cond(
                 spill == 0,
-                lambda _: seamed(aggregate_stream(stream, overlong, mode)),
-                fused_full, None)
+                lambda _: seamed_ret(aggregate_stream(stream, overlong,
+                                                      mode)),
+                fused_full, None), overlong, spill)
 
         if not config.resolved_compact_slots:
-            return full_path(None)
+            res, overlong = full_tok(None)
+            return assemble(res, overlong, None)
         # Slot-compacted planes (config.compact_slots, default-on at 88:
         # +25% end-to-end on the chip, BENCHMARKS.md round 4): the sort
         # input shrinks ~1.45x.  A nonzero spill means some (block, lane)
@@ -319,14 +385,21 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             chunk, config.resolved_compact_slots,
             max_token_bytes=config.pallas_max_token,
             block_rows=config.resolved_block_rows, lane_major=lane_major)
-        return jax.lax.cond(
+        return assemble(jax.lax.cond(
             spill == 0,
             (lambda _: aggregate_stable2(col, seam, overlong)) if lane_major
             else (lambda _: aggregate(col, seam, overlong)),
             full_path,
-            None)
+            None), overlong, spill)
     stream = tok_ops.tokenize(chunk)
-    return table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+    built = table_ops.from_stream(stream, capacity, pos_hi=pos_hi)
+    if not with_stats:
+        return built
+    # XLA backend: no kernel window, no spill/rescue machinery — the only
+    # data-plane signals are the table's own dropped accounting (capacity
+    # spill) and the state gauges ``state_stats`` fills.
+    return built, datastats.map_stats(dropped_tokens=built.dropped_count,
+                                      dropped_uniques=built.dropped_uniques)
 
 
 @functools.partial(jax.jit, static_argnames=("capacity", "config"))
@@ -496,6 +569,33 @@ class WordCountJob:
     def map_chunk(self, chunk: jax.Array, chunk_id: jax.Array):
         return _map_stream(chunk, self.config, self.batch_capacity,
                            pos_hi=chunk_id, split_seam=self._split_seam())
+
+    # -- data-plane telemetry (ISSUE 8) ---------------------------------
+
+    def map_chunk_stats_sharded(self, chunk, chunk_id, axis, device_index):
+        """Stats-mode map: the same update plus the chunk's
+        :class:`...ops.datastats.DataStats` counters.  The engine calls
+        this instead of :meth:`map_chunk` only when data telemetry is on
+        (``Engine(data_stats=True)``); results are bit-identical."""
+        del axis, device_index  # the plain wordcount map is axis-free
+        return _map_stream(chunk, self.config, self.batch_capacity,
+                           pos_hi=chunk_id, split_seam=self._split_seam(),
+                           with_stats=True)
+
+    def _stats_table(self, state) -> table_ops.CountTable:
+        """The running table the data-stats gauges read.  Deliberately
+        NOT :meth:`_plain_table`: flushing a merge_every>1 pending buffer
+        just to observe occupancy would add a K-way reduce per dispatch —
+        the unflushed running table is at most K batches stale, which is
+        telemetry-grade accurate at zero cost."""
+        if isinstance(state, BufferedTableState):
+            return state.table
+        return state
+
+    def state_stats(self, state, stats):
+        """Fill the running-state gauges (occupancy, totals, top-bucket
+        mass, cumulative dropped) after the group's last combine."""
+        return datastats.with_table_gauges(stats, self._stats_table(state))
 
     def _flushed(self, st: BufferedTableState) -> BufferedTableState:
         """Fold all staged batches into the table (no-op when none staged)."""
@@ -727,6 +827,22 @@ class NGramCountJob(WordCountJob):
         return NGramUpdate(batch=t, summaries=gathered,
                            device_index=device_index)
 
+    def map_chunk_stats_sharded(self, chunk, chunk_id, axis, device_index):
+        """Stats-mode map for the gram family: the gram build computes no
+        spill/rescue cond on its own (the fused pair-mode stream has no
+        compact-window fallback), so the chunk counters carry only the
+        batch table's dropped accounting — overlong-poisoned grams — and
+        the gauges come off the running table as everywhere else."""
+        upd = self.map_chunk_sharded(chunk, chunk_id, axis, device_index)
+        tbl = upd.batch if isinstance(upd, NGramUpdate) else upd
+        return upd, datastats.map_stats(dropped_tokens=tbl.dropped_count,
+                                        dropped_uniques=tbl.dropped_uniques)
+
+    def _stats_table(self, state) -> table_ops.CountTable:
+        if isinstance(state, NGramState):
+            return state.table
+        return super()._stats_table(state)
+
     def combine(self, state, update):
         if self.n == 1:
             return super().combine(state, update)
@@ -918,6 +1034,22 @@ class _SketchComposedJob:
         if hook is None:
             return state
         return state._replace(table=hook(state.table))
+
+    # -- data-plane telemetry (ISSUE 8): forward the base job's stats ----
+
+    @property
+    def data_stats_supported(self) -> bool:
+        return datastats.supports(self.base)
+
+    def map_chunk_stats_sharded(self, chunk, chunk_id, axis, device_index):
+        upd, stats = self.base.map_chunk_stats_sharded(
+            chunk, chunk_id, axis, device_index)
+        return self._folded(upd), stats
+
+    def state_stats(self, state, stats):
+        base_state = state.table if isinstance(state, BatchedSketchState) \
+            else state[0]
+        return self.base.state_stats(base_state, stats)
 
     @staticmethod
     def _batch_of(update) -> table_ops.CountTable:

@@ -16,13 +16,18 @@ regions — all still used, now fed through one layer):
   device-idle gap attribution and a critical-path ``bottleneck`` verdict
   (ISSUE 7); ``tools/trace_export.py`` renders the same records as
   Perfetto-viewable Chrome trace-event JSON;
+* :mod:`.datahealth` — jax-free classification of the per-run ``data``
+  record (on-device spill/rescue/skew/occupancy counters, ISSUE 8) into
+  spill-bound / rescue-heavy / skew-hot / occupancy-starved /
+  table-pressure verdicts — the data-shape fitness signal next to the
+  timeline's resource verdict;
 * :mod:`.telemetry` — the facade the executor takes as ONE optional arg.
 
 Reporting: ``tools/obs_report.py`` renders a ledger/flight pair into a run
 summary with anomaly flags.  Schemas: ``docs/observability.md``.
 """
 
-from mapreduce_tpu.obs import timeline
+from mapreduce_tpu.obs import datahealth, timeline
 from mapreduce_tpu.obs.flight import FlightRecorder, summarize_state
 from mapreduce_tpu.obs.ledger import LEDGER_VERSION, RunLedger, read_ledger
 from mapreduce_tpu.obs.registry import MetricsRegistry, get_registry
@@ -32,6 +37,6 @@ from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
 
 __all__ = [
     "FlightRecorder", "LEDGER_VERSION", "MetricsRegistry", "RunLedger",
-    "Telemetry", "device_memory_stats", "get_registry", "maybe",
-    "read_ledger", "span", "summarize_state", "timeline",
+    "Telemetry", "datahealth", "device_memory_stats", "get_registry",
+    "maybe", "read_ledger", "span", "summarize_state", "timeline",
 ]

@@ -1,0 +1,174 @@
+"""Data-health classifier (ISSUE 8): the per-run ``data`` ledger record
+-> a machine-readable verdict on what the DATA did to the run.
+
+PR 7's ``bottleneck`` verdict names the pipeline resource that bounded a
+run (reader/staging/h2d/device/retire); this module names the *data
+shape* that bounded the device side — the fitness signals the window/
+config autotuner (ROADMAP item 1) consumes, and what "Synthesizing
+Optimal Parallelism Placement and Reduction Strategies" (PAPERS.md) makes
+reduction-strategy choice a function of (the key distribution):
+
+==================  =======================================================
+verdict             meaning (and the knob it points at)
+==================  =======================================================
+spill-bound         compact/fused kernel windows overflowed their slot
+                    budget and chunks re-ran at full resolution — each
+                    fallback ~doubles that chunk's map cost (raise
+                    ``--compact-slots``, or the corpus is adversarially
+                    dense)
+rescue-heavy        overlong (>W-byte) tokens are a measurable share of
+                    the stream, or tier-2 rescue escalations fired
+                    (URL/markup-dense text: raise ``--max-token-bytes`` /
+                    the rescue budgets, or accept the accounting)
+skew-hot            one key carries a double-digit share of all tokens
+                    (Zipf-hot): merges and top-k are cheap, but key-range
+                    partitioning would load-imbalance — prefer tree merge
+                    and expect sort runs to be long
+occupancy-starved   the compact kernel windows ran mostly empty — the
+                    sorted stream is mostly padding (shrink
+                    ``--compact-slots`` or grow chunk size)
+table-pressure      the running table is near capacity or actively
+                    dropping keys (raise ``--table-capacity`` or accept
+                    the KMV estimate)
+clean               none of the above fired
+==================  =======================================================
+
+Multiple flags can fire; ``verdict`` is the highest-priority one in the
+table order above (the order is cost impact: a spill fallback doubles map
+work, starved windows only waste sort rows).  Every flag carries its
+measured signal, so the autotuner reads numbers, not adjectives.
+
+Deliberately jax-free and stdlib-only (the ``obs/timeline.py`` contract):
+``tools/obs_report.py`` loads this module by file path on boxes with
+neither jax nor the package installed.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+#: Share of chunks taking the full-resolution fallback that makes a run
+#: spill-bound (each one ~doubles that chunk's map cost).
+SPILL_FALLBACK_FRAC = 0.05
+#: Overlong occurrences as a share of all tokens that makes a run
+#: rescue-heavy (natural text measures ~0; webby text ~5e-4/chunk budget).
+OVERLONG_FRAC = 1e-3
+#: Top single-key mass that makes a corpus skew-hot.  Zipf-ish natural
+#: text puts >5% of all tokens on the top key ("the"); a uniform corpus
+#: puts ~1/distinct there.
+TOP_MASS_HOT = 0.05
+#: Compact-window slot occupancy below which the sort input is mostly
+#: padding (the stable2 windows carry `slots` rows whether used or not).
+WINDOW_OCCUPANCY_FLOOR = 0.25
+#: Running-table occupancy that signals imminent key spill.
+TABLE_OCCUPANCY_CEIL = 0.9
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _frac(num, den) -> Optional[float]:
+    n, d = _num(num), _num(den)
+    if n is None or not d:
+        return None
+    return n / d
+
+
+def classify(data: dict) -> dict:
+    """One run's ``data`` record -> ``{verdict, flags, signals}``.
+
+    ``signals`` carries every derived ratio (present or None — absence of
+    a signal is itself information: an xla-backend run has no windows to
+    starve); each entry of ``flags`` carries the measured number that
+    fired it.  Unknown/extra fields in ``data`` are ignored (ledger
+    forward compat)."""
+    chunks = _num(data.get("chunks")) or 0.0
+    tokens = _num(data.get("tokens")) or 0.0
+    signals = {
+        "fallback_frac": _frac(data.get("fallback_chunks", 0), chunks),
+        "overlong_frac": _frac(data.get("overlong", 0), tokens),
+        "rescued_frac": _frac(data.get("rescued", 0),
+                              data.get("overlong", 0)),
+        "dropped_frac": _frac(data.get("dropped_tokens", 0), tokens),
+        "top_mass": _frac(data.get("top_count", 0), tokens),
+        "distinct_ratio": _frac(data.get("table_valid", 0), tokens),
+        "table_occupancy": _frac(data.get("table_valid", 0),
+                                 data.get("capacity", 0)),
+        "window_occupancy": _num(data.get("window_occupancy")),
+        "rescue_escalations": _num(data.get("rescue_escalations", 0)),
+    }
+    signals = {k: (round(v, 6) if v is not None else None)
+               for k, v in signals.items()}
+    flags = []
+
+    def flag(name: str, detail: str) -> None:
+        flags.append({"flag": name, "detail": detail})
+
+    ff = signals["fallback_frac"]
+    if ff is not None and ff > SPILL_FALLBACK_FRAC:
+        flag("spill-bound",
+             f"{ff:.1%} of chunks overflowed their compact window slots "
+             f"and re-ran at full resolution (spill_rows="
+             f"{data.get('spill_rows', 0)}) — each fallback ~doubles that "
+             "chunk's map cost; raise --compact-slots or accept the 2x")
+    of = signals["overlong_frac"]
+    esc = signals["rescue_escalations"] or 0
+    if (of is not None and of > OVERLONG_FRAC) or esc > 0:
+        rf = signals["rescued_frac"]
+        rescued_part = f", rescued {rf:.0%} of them" if rf is not None else ""
+        flag("rescue-heavy",
+             f"overlong tokens are {(of or 0):.2%} of the stream with "
+             f"{int(esc)} tier-2 escalations{rescued_part} — raise "
+             "--max-token-bytes / the rescue budgets for URL-dense text")
+    tm = signals["top_mass"]
+    if tm is not None and tm > TOP_MASS_HOT:
+        flag("skew-hot",
+             f"the hottest key carries {tm:.1%} of all tokens "
+             "(Zipf-hot): key-range partitioning would load-imbalance — "
+             "prefer tree merge; sort runs will be long")
+    wo = signals["window_occupancy"]
+    if wo is not None and wo < WINDOW_OCCUPANCY_FLOOR:
+        flag("occupancy-starved",
+             f"compact kernel windows ran {wo:.1%} full: the aggregation "
+             "sort is mostly sorting padding — shrink --compact-slots or "
+             "grow the chunk")
+    to = signals["table_occupancy"]
+    dropped_uniques = _num(data.get("dropped_uniques", 0)) or 0
+    if (to is not None and to > TABLE_OCCUPANCY_CEIL) or dropped_uniques > 0:
+        flag("table-pressure",
+             f"running table {to if to is not None else 0:.0%} full, "
+             f"{int(dropped_uniques)} distinct keys spilled — raise "
+             "--table-capacity or rely on the KMV/HLL estimates")
+
+    order = ["spill-bound", "rescue-heavy", "skew-hot",
+             "occupancy-starved", "table-pressure"]
+    fired = {f["flag"] for f in flags}
+    verdict = next((v for v in order if v in fired), "clean")
+    return {"verdict": verdict, "flags": flags, "signals": signals}
+
+
+def data_record(records: Iterable[dict],
+                run_id: Optional[str] = None) -> Optional[dict]:
+    """The ``data`` record of one run (the first run carrying one when
+    ``run_id`` is not given).  Unknown kinds/malformed rows skip — the
+    ledger forward-compat contract."""
+    chosen = run_id
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "data":
+            continue
+        if chosen is None:
+            chosen = rec.get("run_id")
+        if rec.get("run_id") == chosen:
+            return rec
+    return None
+
+
+def classify_run(records: Iterable[dict],
+                 run_id: Optional[str] = None) -> Optional[dict]:
+    """Ledger records -> the health artifact of one run, or None when the
+    run carries no ``data`` record (pre-ISSUE-8 ledgers degrade to "no
+    data-health section", never to an error)."""
+    rec = data_record(records, run_id)
+    return classify(rec) if rec is not None else None

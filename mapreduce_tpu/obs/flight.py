@@ -46,7 +46,9 @@ class FlightRecorder:
 
     def dump(self, path: str, context: Optional[dict] = None,
              state_summary: Optional[dict] = None,
-             registry_snapshot: Optional[dict] = None) -> Optional[str]:
+             registry_snapshot: Optional[dict] = None,
+             data: Optional[dict] = None,
+             data_health: Optional[dict] = None) -> Optional[str]:
         """Write the forensics file; returns the path actually written, or
         ``None`` when the write failed (read-only/full filesystem) — a
         ledger failure record must not point at a dump that does not
@@ -67,6 +69,10 @@ class FlightRecorder:
             payload["state"] = state_summary
         if registry_snapshot is not None:
             payload["metrics"] = registry_snapshot
+        if data is not None:  # data-plane snapshot as of the crash (ISSUE 8)
+            payload["data"] = data
+        if data_health is not None:
+            payload["data_health"] = data_health
         try:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)

@@ -28,7 +28,16 @@ group          one per RETIRED superstep group (ISSUE 7): monotonic-clock
                token_ready_at/retired_at, h2d_done_at on the last group),
                group bytes/steps, retire_wait_s, retry attempts — the raw
                material ``obs/timeline.py`` reconstructs per-resource
-               timelines, overlap matrices and critical-path verdicts from
+               timelines, overlap matrices and critical-path verdicts
+               from — plus the group's ``data`` dict (ISSUE 8: per-group
+               overlong/rescued/dropped/spill-fallback counters and
+               running occupancy/top-mass) on stats-mode runs
+data           one per run (ISSUE 8, before run_end): the data-plane
+               summary — overlong/rescued/dropped totals, spill-fallback
+               and rescue-escalation counts, table occupancy, top-bucket
+               mass (key-skew proxy), stable2 window occupancy —
+               classified by ``obs/datahealth.py`` and consumed by the
+               window autotuner next to the timeline verdict
 checkpoint     step, cursor_bytes, save_s, path
 retry          step, attempt, error
 failure        step, cursor_bytes, error, flight-dump path (if written)
@@ -54,8 +63,10 @@ from typing import Iterator, Optional
 
 #: Bumped when the record stream gains kinds/fields a consumer may care to
 #: version-gate on.  1 = ISSUE 2-6 shape (implicit; pre-ISSUE-7 ledgers
-#: carry no version field at all); 2 = adds ``group`` lifecycle records.
-LEDGER_VERSION = 2
+#: carry no version field at all); 2 = adds ``group`` lifecycle records;
+#: 3 = adds the per-run ``data`` record + per-group ``data`` dicts
+#: (ISSUE 8).
+LEDGER_VERSION = 3
 
 
 class RunLedger:

@@ -128,6 +128,10 @@ class Telemetry:
         self.sample_device_stats = sample_device_stats
         self.run_id = ledger.run_id if ledger is not None \
             else uuid.uuid4().hex[:12]
+        # Latest data-plane summary (ISSUE 8): the executor updates it at
+        # every group retirement, so a flight dump on the failure path
+        # carries the run's data-health snapshot as of the crash.
+        self.last_data: Optional[dict] = None
         self._last_phases: dict = {}
         self._last_record_t: Optional[float] = None
         self._pending_compiles: list = []
@@ -248,11 +252,21 @@ class Telemetry:
             rec["compile_events"] = compiles
         self.ledger.write("step", **rec)
 
+    def note_data(self, data: Optional[dict]) -> None:
+        """Record the latest data-plane run summary (ISSUE 8) so the
+        flight recorder's failure dump carries it.  A dict assignment —
+        no I/O, no device work; no-op when disabled."""
+        if self.enabled and data is not None:
+            self.last_data = data
+
     def flight_dump(self, context: Optional[dict] = None,
                     state: Any = None) -> Optional[str]:
-        """Dump the flight ring + state summary + registry snapshot.
-        Returns the dump path (None when telemetry is off or pathless).
-        Idempotent: the first failure of a run owns the file."""
+        """Dump the flight ring + state summary + registry snapshot —
+        plus the latest data-plane summary and its health classification
+        (ISSUE 8), so a crashed run's forensics say what the DATA was
+        doing, not just what the host loop was.  Returns the dump path
+        (None when telemetry is off or pathless).  Idempotent: the first
+        failure of a run owns the file."""
         if not (self.enabled and self.flight is not None and self.flight_path):
             return None
         summary = None
@@ -261,9 +275,19 @@ class Telemetry:
                 summary = flight_mod.summarize_state(state)
             except Exception:
                 summary = {"error": "state summary failed"}
+        data_health = None
+        if self.last_data is not None:
+            try:  # jax-free classifier; a dump must never mask the failure
+                from mapreduce_tpu.obs import datahealth
+
+                data_health = datahealth.classify(self.last_data)
+            except Exception:
+                data_health = {"error": "classification failed"}
         return self.flight.dump(self.flight_path, context=context,
                                 state_summary=summary,
-                                registry_snapshot=self.registry.snapshot())
+                                registry_snapshot=self.registry.snapshot(),
+                                data=self.last_data,
+                                data_health=data_health)
 
     def close(self) -> None:
         """Flush/close the ledger and stop receiving compile events."""

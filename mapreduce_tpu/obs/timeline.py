@@ -311,6 +311,15 @@ def to_chrome_trace(records: Iterable[dict],
         args = {k: rec.get(k) for k in
                 ("step_first", "step_last", "steps", "group_bytes",
                  "retries", "retire_wait_s") if rec.get(k) is not None}
+        # Data-plane annotations (ISSUE 8): the group's spill/rescue/
+        # occupancy counters ride every slice's args (click a slice in
+        # Perfetto to see what the data did), and groups that took the
+        # spill-fallback or rescue-escalation cond get an instant marker
+        # on the device lane — the 2x-map-cost chunks are visible as
+        # events, not just numbers.
+        data = rec.get("data")
+        if isinstance(data, dict):
+            args["data"] = data
         for lane, (s, e) in iv.items():
             if (pid[lane], gid) not in named_threads:
                 named_threads.add((pid[lane], gid))
@@ -321,6 +330,19 @@ def to_chrome_trace(records: Iterable[dict],
                            "name": f"{_SLICE[lane]} {label}",
                            "pid": pid[lane], "tid": gid, "ts": us(s),
                            "dur": round((e - s) * 1e6, 3), "args": args})
+        if isinstance(data, dict) and "device" in iv:
+            marks = []
+            if data.get("fallback_chunks"):
+                marks.append(f"{data['fallback_chunks']} spill fallback(s)")
+            if data.get("rescue_escalations"):
+                marks.append(f"{data['rescue_escalations']} rescue "
+                             "escalation(s)")
+            if marks:
+                events.append({"ph": "i", "s": "t", "cat": "data",
+                               "name": f"data: {', '.join(marks)} {label}",
+                               "pid": pid["device"], "tid": gid,
+                               "ts": us(iv["device"][0]),
+                               "args": dict(data)})
         # Flow arrow: the dispatch hand-off from the staging lane into the
         # device lane (binds to the enclosing slices at each end).
         if "staging" in iv and "device" in iv:

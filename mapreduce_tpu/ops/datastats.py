@@ -1,0 +1,272 @@
+"""Data-plane telemetry counters (ISSUE 8 tentpole).
+
+The map path computes spill fallbacks, rescue-tier escalations, dropped-
+token accounting, and table shape ON DEVICE — but until now none of it
+reached the obs layer: the ledger knew how long a dispatch took, never
+what the data did to it.  This module is the seam:
+
+* :class:`DataStats` — a tiny pytree of uint32 scalars (per shard) the
+  stats-mode engine step returns NEXT TO the new state.  Counter fields
+  are per-dispatch-group deltas (summed over the group's chunks at trace
+  time); gauge fields are running values read off the post-group state.
+  The output is non-donated and a few dozen bytes per device: the
+  executor fetches it at group retirement, where the group's completion
+  token already proved the program finished — no host callback, no added
+  device sync (the PR-2 discipline the graphcheck host-sync pass
+  certifies).
+* :class:`DataAggregator` — the host-side fold: per-group summaries for
+  ``group`` ledger records and the one per-run ``data`` summary record
+  (schema: docs/observability.md), which ``obs/datahealth.py`` classifies
+  and the window autotuner (ROADMAP item 1) consumes next to the PR-7
+  ``bottleneck`` verdict.
+
+Counter exactness: every counter is a per-chunk uint32 delta bounded by
+tokens-per-chunk (< 2**24 at the 64 MB chunk ceiling), summed over at
+most a superstep of chunks at trace time and in int64 on the host — no
+32-bit wrap anywhere.  The 64-bit running gauges (total tokens, top
+count, dropped) ride as lo/hi uint32 lane pairs, the CountTable idiom.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataStats(NamedTuple):
+    """Per-shard data-plane stats.  All fields are uint32 scalars.
+
+    Counters (per-group deltas, summed over the group's chunks):
+
+    * ``chunks`` — chunks mapped in this dispatch group;
+    * ``overlong`` — token occurrences longer than the kernel window W
+      (pre-rescue; the pallas backend's only lossy envelope);
+    * ``rescued`` — overlong occurrences recovered exactly by the
+      bounded rescue pass (``ops/rescue.py``);
+    * ``dropped_tokens`` / ``dropped_uniques`` — the per-chunk batch
+      tables' ``dropped_*`` scalars (unrescued overlong residue +
+      batch-capacity spill), i.e. the same accounting the result carries;
+    * ``rescue_invocations`` — chunks whose ``overlong > 0`` cond took
+      the rescue branch;
+    * ``rescue_escalations`` — chunks whose overlong count exceeded the
+      tier-1 budget and escalated to the full extraction
+      (``Config.rescue_slots_max``);
+    * ``fallback_chunks`` — chunks whose compact/fused kernel spilled a
+      (block, lane) window and re-ran at full resolution (the
+      ``lax.cond`` fallback branch taken — each one ~doubles that
+      chunk's map cost);
+    * ``spill_rows`` — emissions past the slot budget (the kernels' SMEM
+      spill scalar, summed).
+
+    Gauges (running values off the post-group state, filled by
+    ``job.state_stats``):
+
+    * ``table_valid`` — occupied slots in this shard's running table;
+    * ``total_lo``/``total_hi`` — exact 64-bit total tokens including
+      dropped (``CountTable.total_count64``);
+    * ``top_lo``/``top_hi`` — the largest single-key count (top-bucket
+      mass: the cheap key-skew proxy — Zipf-hot corpora put a double-
+      digit share of all tokens on one key, uniform corpora ~1/distinct);
+    * ``dropped_lo``/``dropped_hi`` — cumulative dropped tokens (joins
+      resumed history the per-group counters cannot see).
+    """
+
+    chunks: jax.Array
+    overlong: jax.Array
+    rescued: jax.Array
+    dropped_tokens: jax.Array
+    dropped_uniques: jax.Array
+    rescue_invocations: jax.Array
+    rescue_escalations: jax.Array
+    fallback_chunks: jax.Array
+    spill_rows: jax.Array
+    table_valid: jax.Array
+    total_lo: jax.Array
+    total_hi: jax.Array
+    top_lo: jax.Array
+    top_hi: jax.Array
+    dropped_lo: jax.Array
+    dropped_hi: jax.Array
+
+
+_N_FIELDS = len(DataStats._fields)
+#: Fields summed per chunk at trace time (everything before the gauges).
+_COUNTERS = ("chunks", "overlong", "rescued", "dropped_tokens",
+             "dropped_uniques", "rescue_invocations", "rescue_escalations",
+             "fallback_chunks", "spill_rows")
+
+
+def zeros() -> DataStats:
+    z = jnp.zeros((), jnp.uint32)
+    return DataStats(*([z] * _N_FIELDS))
+
+
+def _u32(x) -> jax.Array:
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def map_stats(*, overlong=0, rescued=0, spill=0, fallback=0,
+              invoked=0, escalated=0, dropped_tokens=0,
+              dropped_uniques=0) -> DataStats:
+    """One chunk's counter delta (gauges zero; ``state_stats`` fills them
+    after the group's last combine).  All arguments accept uint32 scalars
+    or Python ints; predicates arrive as 0/1 values."""
+    return zeros()._replace(
+        chunks=jnp.ones((), jnp.uint32),
+        overlong=_u32(overlong), rescued=_u32(rescued),
+        spill_rows=_u32(spill), fallback_chunks=_u32(fallback),
+        rescue_invocations=_u32(invoked), rescue_escalations=_u32(escalated),
+        dropped_tokens=_u32(dropped_tokens),
+        dropped_uniques=_u32(dropped_uniques))
+
+
+def add(a: DataStats, b: DataStats) -> DataStats:
+    """Fold two chunk deltas (the superstep scan's accumulator).  Gauges
+    add too — harmless: ``with_table_gauges`` overwrites them after the
+    group's last chunk."""
+    return DataStats(*(x + y for x, y in zip(a, b)))
+
+
+def with_table_gauges(stats: DataStats, table) -> DataStats:
+    """Fill the running-state gauges from a :class:`...ops.table.CountTable`
+    (the post-group running table).  Costs two reductions over the
+    capacity-sized count lanes — noise next to the chunk-sized map (the
+    hbm-cost pass ERROR-gates the whole instrumentation at <= 1% extra
+    effective input passes)."""
+    total_lo, total_hi = table.total_count64()
+    # Largest per-key 64-bit count without a device uint64: the max hi
+    # lane first, then the max lo lane among keys AT that hi lane.
+    top_hi = jnp.max(table.count_hi)
+    top_lo = jnp.max(jnp.where(table.count_hi == top_hi, table.count, 0))
+    return stats._replace(
+        table_valid=table.n_valid(),
+        total_lo=total_lo, total_hi=total_hi,
+        top_lo=top_lo, top_hi=top_hi,
+        dropped_lo=table.dropped_count, dropped_hi=table.dropped_count_hi)
+
+
+def supports(job) -> bool:
+    """Does this job emit data-plane stats?  Duck-typed like every other
+    job hook; wrappers (sketch composition) forward their base job's
+    answer through ``data_stats_supported``."""
+    flag = getattr(job, "data_stats_supported", None)
+    if flag is not None:
+        return bool(flag)
+    return (callable(getattr(job, "map_chunk_stats_sharded", None))
+            and callable(getattr(job, "state_stats", None)))
+
+
+# -- host side ---------------------------------------------------------------
+
+
+def window_slot_capacity(config) -> int | None:
+    """Token-emission slot capacity of one chunk's compact kernel windows
+    (the stable2 window-occupancy denominator): ``blocks * 128 lanes *
+    compact_slots``.  None when the config does not run the compact
+    pallas path (nothing to be occupancy-starved about)."""
+    try:
+        if config.resolved_backend() != "pallas":
+            return None
+    except Exception:
+        return None  # backend resolution may need jax; stats just degrade
+    slots = config.resolved_compact_slots
+    if not slots:
+        return None
+    block_rows = config.resolved_block_rows or 256
+    seg = config.chunk_bytes // 128
+    blocks = -(-seg // block_rows)
+    return blocks * 128 * slots
+
+
+def _pair64(lo, hi) -> int:
+    return (int(hi) << 32) | int(lo)
+
+
+class DataAggregator:
+    """Host-side fold of per-group :class:`DataStats` fetches.
+
+    ``group_data`` reduces one group's per-device leaves ([D]-shaped
+    numpy) into the small dict the ``group`` ledger record carries and
+    accumulates run totals; ``run_record`` emits the per-run ``data``
+    summary record.  Pure numpy/int math — never touches a device.
+    """
+
+    def __init__(self, *, capacity: int, devices: int,
+                 backend: str, map_impl: str,
+                 slot_capacity_per_chunk: int | None = None):
+        self.capacity = int(capacity)
+        self.devices = int(devices)
+        self.backend = backend
+        self.map_impl = map_impl
+        self.slot_capacity = slot_capacity_per_chunk
+        self.groups = 0
+        self.totals = {k: 0 for k in _COUNTERS}
+        self.final: dict = {}
+
+    @classmethod
+    def for_run(cls, config, devices: int) -> "DataAggregator":
+        return cls(capacity=config.table_capacity, devices=devices,
+                   backend=config.resolved_backend(),
+                   map_impl=config.map_impl,
+                   slot_capacity_per_chunk=window_slot_capacity(config))
+
+    def group_data(self, stats_host: DataStats) -> dict:
+        """One retired group's [D]-leaf stats -> the ``group`` record's
+        ``data`` dict (per-group counters + running occupancy/skew),
+        folding the counters into the run totals."""
+        s = {f: np.asarray(v) for f, v in zip(DataStats._fields, stats_host)}
+        out: dict = {}
+        for k in _COUNTERS:
+            v = int(s[k].sum(dtype=np.int64))
+            self.totals[k] += v
+            if k != "chunks" and v:
+                out[k] = v
+        out["chunks"] = int(s["chunks"].sum(dtype=np.int64))
+        valid = int(s["table_valid"].sum(dtype=np.int64))
+        total = sum(_pair64(lo, hi) for lo, hi in
+                    zip(s["total_lo"].ravel(), s["total_hi"].ravel()))
+        top = max((_pair64(lo, hi) for lo, hi in
+                   zip(s["top_lo"].ravel(), s["top_hi"].ravel())),
+                  default=0)
+        dropped = sum(_pair64(lo, hi) for lo, hi in
+                      zip(s["dropped_lo"].ravel(), s["dropped_hi"].ravel()))
+        self.final = {"table_valid": valid, "tokens": total,
+                      "top_count": top, "dropped_cumulative": dropped}
+        out["occupancy"] = round(valid / max(self.capacity * self.devices, 1),
+                                 4)
+        if total:
+            out["top_mass"] = round(top / total, 6)
+        self.groups += 1
+        return out
+
+    def snapshot(self) -> dict:
+        """The run summary as of the last retired group (the flight
+        recorder's data-health snapshot on the failure path)."""
+        return self.run_record()
+
+    def run_record(self) -> dict:
+        """The per-run ``data`` ledger record (docs/observability.md)."""
+        rec: dict = {"groups": self.groups, "backend": self.backend,
+                     "map_impl": self.map_impl,
+                     "capacity": self.capacity * self.devices}
+        rec.update(self.totals)
+        f = self.final
+        tokens = f.get("tokens", 0)
+        rec["tokens"] = tokens
+        rec["table_valid"] = f.get("table_valid", 0)
+        rec["top_count"] = f.get("top_count", 0)
+        rec["dropped_cumulative"] = f.get("dropped_cumulative", 0)
+        rec["table_occupancy"] = round(
+            rec["table_valid"] / max(rec["capacity"], 1), 4)
+        if tokens:
+            rec["top_mass"] = round(rec["top_count"] / tokens, 6)
+            rec["distinct_ratio"] = round(rec["table_valid"] / tokens, 6)
+            rec["dropped_frac"] = round(rec["dropped_tokens"] / tokens, 6)
+        if self.slot_capacity and self.totals["chunks"] and tokens:
+            cap = self.slot_capacity * self.totals["chunks"]
+            rec["window_slot_capacity"] = cap
+            rec["window_occupancy"] = round(tokens / cap, 4)
+        return rec

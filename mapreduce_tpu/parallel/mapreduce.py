@@ -112,7 +112,7 @@ class Engine:
 
     def __init__(self, job: MapReduceJob, mesh: Mesh,
                  axis: str | tuple[str, ...] = "data",
-                 merge_strategy: str = "tree"):
+                 merge_strategy: str = "tree", data_stats: bool = False):
         axes = (axis,) if isinstance(axis, str) else tuple(axis)
         for a in axes:
             if a not in mesh.axis_names:
@@ -132,6 +132,22 @@ class Engine:
                 "merge_strategy='keyrange' needs a job with a keyrange_merge "
                 "hook (the CountTable wordcount family); use 'tree'/'gather' "
                 f"for {type(job).__name__}")
+        # Data-plane telemetry (ISSUE 8): when on, step/step_many return
+        # ``(state, DataStats)`` — the stats leaves are tiny uint32 scalars
+        # per shard, a NON-donated second output the executor fetches at
+        # group retirement (the completion token already proved the program
+        # finished, so the fetch observes, never syncs).  Off (default):
+        # the built programs are bit-identical to pre-ISSUE-8.  Support is
+        # duck-typed by ``ops.datastats.supports`` (the hooks, or a
+        # wrapper's forwarded ``data_stats_supported``).
+        if data_stats:
+            from mapreduce_tpu.ops import datastats
+
+            if not datastats.supports(job):
+                raise ValueError(
+                    f"data_stats=True but {type(job).__name__} has no "
+                    "map_chunk_stats_sharded/state_stats hooks")
+        self.data_stats = bool(data_stats)
         self._keyrange = merge_strategy == "keyrange"
         # Multi-axis meshes reduce level by level (innermost = fastest link
         # first); single-axis meshes use the chosen strategy directly.
@@ -207,6 +223,13 @@ class Engine:
             chunk = chunks[0]
             dev = self._device_index()
             chunk_id = step * jnp.uint32(n) + dev
+            if self.data_stats:
+                update, stats = job.map_chunk_stats_sharded(
+                    chunk, chunk_id, axis, dev)
+                new = job.combine(local, update)
+                stats = job.state_stats(new, stats)
+                return (jax.tree.map(lambda x: x[None], new),
+                        jax.tree.map(lambda x: x[None], stats))
             update = _map_with_axis(job, chunk, chunk_id, axis, dev)
             new = job.combine(local, update)
             return jax.tree.map(lambda x: x[None], new)
@@ -214,7 +237,7 @@ class Engine:
         fn = shard_map(
             local_step, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P()),
-            out_specs=P(axis),
+            out_specs=(P(axis), P(axis)) if self.data_stats else P(axis),
             check_vma=False,
         )
         # Explicit in_shardings: without them XLA may propagate a sharding
@@ -233,14 +256,35 @@ class Engine:
             my = chunks[0]  # (k, chunk_bytes) after shard_map
             dev = self._device_index()
 
-            def body(st, j):
+            def chunk_at(j):
                 # Cycle over the k resident chunks: pass r of `repeats`
                 # re-reads them with fresh step indices (epoch semantics).
-                chunk = jax.lax.dynamic_index_in_dim(
+                return jax.lax.dynamic_index_in_dim(
                     my, (j % jnp.uint32(k)).astype(jnp.int32), keepdims=False)
+
+            if self.data_stats:
+                from mapreduce_tpu.ops import datastats
+
+                def body_stats(carry, j):
+                    st, acc = carry
+                    chunk_id = (step0 + j) * jnp.uint32(n) + dev
+                    update, stats = job.map_chunk_stats_sharded(
+                        chunk_at(j), chunk_id, axis, dev)
+                    return (job.combine(st, update),
+                            datastats.add(acc, stats)), None
+
+                (new, acc), _ = jax.lax.scan(
+                    body_stats, (local, datastats.zeros()),
+                    jnp.arange(k * repeats, dtype=jnp.uint32))
+                acc = job.state_stats(new, acc)
+                return (jax.tree.map(lambda x: x[None], new),
+                        jax.tree.map(lambda x: x[None], acc))
+
+            def body(st, j):
                 chunk_id = (step0 + j) * jnp.uint32(n) + dev
                 return job.combine(
-                    st, _map_with_axis(job, chunk, chunk_id, axis, dev)), None
+                    st, _map_with_axis(job, chunk_at(j), chunk_id, axis,
+                                       dev)), None
 
             new, _ = jax.lax.scan(
                 body, local, jnp.arange(k * repeats, dtype=jnp.uint32))
@@ -249,7 +293,7 @@ class Engine:
         fn = shard_map(
             local_many, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P()),
-            out_specs=P(axis),
+            out_specs=(P(axis), P(axis)) if self.data_stats else P(axis),
             check_vma=False,
         )
         return jax.jit(fn, donate_argnums=(0,),
@@ -277,7 +321,11 @@ class Engine:
     # -- public API ----------------------------------------------------------
 
     def step(self, state: Any, chunks: jax.Array, step_index: int) -> Any:
-        """One map+combine step.  ``chunks``: uint8[n_devices, chunk_bytes]."""
+        """One map+combine step.  ``chunks``: uint8[n_devices, chunk_bytes].
+
+        With ``data_stats=True`` (construction-time) the return value is
+        ``(new_state, DataStats)`` — the stats pytree's leaves are [D]
+        uint32 scalars, non-donated, ready together with the state."""
         if self._step_fn is None:
             self._step_fn = self._build_step()
         chunks = jax.device_put(chunks, self._sharded)

@@ -202,6 +202,11 @@ class _Inflight:
     step_first: int
     cursor_before: int  # bytes_done before this group (honest failure cursor)
     life: dict  # lifecycle timestamps + sizes (the `group` ledger record)
+    # Data-plane stats output of the group's step program (ISSUE 8):
+    # a tiny non-donated DataStats pytree, ready together with the
+    # completion token; fetched at retirement.  None when telemetry is
+    # off or the job has no stats hooks.
+    stats: Any = None
 
 
 def _group_life(group, read_at: Optional[float], group_bytes: int) -> dict:
@@ -217,13 +222,29 @@ def _group_life(group, read_at: Optional[float], group_bytes: int) -> dict:
             "staged_at": round(time.perf_counter(), 6)}
 
 
+#: group-record ``data`` counters mirrored into registry counters at
+#: retirement (per-group deltas; names match the ledger fields).
+_DATA_COUNTER_METRICS = (
+    ("overlong", "data.overlong_tokens"),
+    ("rescued", "data.rescued_tokens"),
+    ("dropped_tokens", "data.dropped_tokens"),
+    ("fallback_chunks", "data.spill_fallback_chunks"),
+    ("rescue_escalations", "data.rescue_escalations"),
+    ("spill_rows", "data.spill_rows"),
+)
+
+
 def _group_record(tel, write: bool, life: dict, token_ready_at: float,
-                  retired_at: float, wait_s: float, retries: int = 0) -> None:
+                  retired_at: float, wait_s: float, retries: int = 0,
+                  data: Optional[dict] = None) -> None:
     """Emit one ``group`` ledger record for a RETIRED group — the lifecycle
     raw material ``obs/timeline.py`` reconstructs lanes from.  Pure
     host-side bookkeeping: a handful of ``perf_counter`` stamps and one
     JSONL append (same cost class as the step record written at dispatch);
-    a unit test holds the non-I/O part under 1 ms per group."""
+    a unit test holds the non-I/O part under 1 ms per group.  ``data``
+    (ISSUE 8): the group's data-plane counter dict, already reduced
+    host-side by :class:`...ops.datastats.DataAggregator` — attached to
+    the record and mirrored into the registry's ``data.*`` instruments."""
     tel.registry.counter("executor.groups_retired").inc()
     d = life.get("dispatched_at")
     if d is not None:
@@ -236,6 +257,16 @@ def _group_record(tel, write: bool, life: dict, token_ready_at: float,
     rec["retire_wait_s"] = round(max(0.0, wait_s), 6)
     if retries:
         rec["retries"] = retries
+    if data is not None:
+        rec["data"] = data
+        for field, metric in _DATA_COUNTER_METRICS:
+            v = data.get(field)
+            if v:
+                tel.registry.counter(metric).inc(v)
+        if data.get("occupancy") is not None:
+            tel.registry.gauge("data.table_occupancy").set(data["occupancy"])
+        if data.get("top_mass") is not None:
+            tel.registry.gauge("data.top_mass").set(data["top_mass"])
     if write:
         tel.ledger_write("group", **rec)
 
@@ -244,7 +275,8 @@ def _drive_stream(engine, job, config: Config, path, state,
                   hooks: _StreamHooks, *, start_step: int, start_offset: int,
                   end_offset, bases_list: list, checkpoint_path,
                   checkpoint_every: int, fingerprint, resumed_file,
-                  logger, progress_every: int, timer=None, telemetry=None):
+                  logger, progress_every: int, timer=None, telemetry=None,
+                  data_agg=None):
     """The shared streaming loop: reader -> prefetch -> superstep groups ->
     a bounded in-flight dispatch window (ISSUE 5), with checkpoint cadence
     and file-boundary hooks.  Returns ``(state, bytes_done, step_index,
@@ -345,7 +377,22 @@ def _drive_stream(engine, job, config: Config, path, state,
                 out = engine.step(state, staged, group[0].step)
             else:
                 out = engine.step_many(state, staged, group[0].step)
-        return out, staged
+        stats = None
+        if engine.data_stats:
+            out, stats = out
+        return out, stats, staged
+
+    def group_stats_data(stats):
+        """Fetch one retired group's DataStats leaves and fold them into
+        the run aggregate (ISSUE 8).  Called only after the group's
+        completion token was observed ready — the stats arrays are
+        outputs of the same program, so the fetch copies a few dozen
+        ready bytes, it never waits on the device."""
+        if stats is None or data_agg is None:
+            return None
+        data = data_agg.group_data(jax.tree.map(np.asarray, stats))
+        tel.note_data(data_agg.snapshot())
+        return data
 
     def split_at_checkpoints(group):
         """Cut a superstep group at checkpoint boundaries, so resume
@@ -416,14 +463,14 @@ def _drive_stream(engine, job, config: Config, path, state,
         while True:
             staged = None
             try:
-                out, staged = dispatch(state, group)
+                out, stats, staged = dispatch(state, group)
                 with obs.span("retire_wait", timer):
                     jax.block_until_ready(out)
                 if hooks.stage_release is not None:
                     hooks.stage_release(staged)
                 if used_out is not None:
                     used_out[0] = attempt
-                return out
+                return out, stats
             except Exception as e:
                 # Return the failed attempt's staging buffer so its id
                 # never dangles in the pool (the doomed H2D may still read
@@ -492,7 +539,7 @@ def _drive_stream(engine, job, config: Config, path, state,
         used = [1]
         for i, (group, group_cursor) in enumerate(replay):
             replay_t0 = time.perf_counter()
-            state = serial_dispatch(
+            state, replay_stats = serial_dispatch(
                 state, group, attempts_used=1 if i == fail_idx else 0,
                 used_out=used if i == fail_idx else None,
                 cursor=group_cursor)
@@ -503,13 +550,17 @@ def _drive_stream(engine, job, config: Config, path, state,
                 # the group's real completion interval (stage/dispatch/
                 # wait are not separable from out here — a timeline shows
                 # one serialized device slab, which is the truth).
+                # Data stats fold only for groups that never retired: a
+                # group replayed from the anchor but retired earlier
+                # already contributed its counters once.
                 done = time.perf_counter()
                 life = dict(life, staged_at=round(replay_t0, 6),
                             dispatched_at=round(replay_t0, 6))
                 _group_record(tel, hooks.write_gate(), life,
                               token_ready_at=done, retired_at=done,
                               wait_s=done - replay_t0,
-                              retries=used[0] if i == fail_idx else 0)
+                              retries=used[0] if i == fail_idx else 0,
+                              data=group_stats_data(replay_stats))
         tel.registry.counter("executor.retry_recoveries").inc()
         if sync_group is not None:
             # The sync-failed group raised inside `dispatch` itself, so it
@@ -546,7 +597,8 @@ def _drive_stream(engine, job, config: Config, path, state,
         _group_record(tel, hooks.write_gate(), entry.life,
                       token_ready_at=token_ready_at,
                       retired_at=time.perf_counter(),
-                      wait_s=token_ready_at - wait_t0)
+                      wait_s=token_ready_at - wait_t0,
+                      data=group_stats_data(entry.stats))
         return state
 
     def drain_window(state, phase="retire_wait", do_reanchor=True):
@@ -592,7 +644,7 @@ def _drive_stream(engine, job, config: Config, path, state,
         if progress_every and step_index % progress_every < len(group):
             log_event(logger, "progress", step=step_index, bytes=bytes_done)
 
-    def enroll(out, staged, group, cursor_before, life):
+    def enroll(out, stats, staged, group, cursor_before, life):
         """Window bookkeeping + accounting for a DISPATCHED group.  Runs
         outside the recover() routing on purpose: a failure here (say the
         ledger's disk filling up mid step-record) is host bookkeeping, not
@@ -603,7 +655,7 @@ def _drive_stream(engine, job, config: Config, path, state,
         window.append(_Inflight(
             token=_state_token(out), staged=staged,
             step_first=group[0].step, cursor_before=cursor_before,
-            life=life))
+            life=life, stats=stats))
         if hooks.retry > 0:
             # Paired with the pre-group cursor, so a replay that later
             # exhausts its retries can report where THIS group started.
@@ -653,14 +705,14 @@ def _drive_stream(engine, job, config: Config, path, state,
         life = _group_life(group, read_at,
                            int(sum(int(b.lengths.sum()) for b in group)))
         try:
-            out, staged = dispatch(state, group)
+            out, stats, staged = dispatch(state, group)
         except Exception as e:
             # Only the dispatch itself routes here: a device/staging fault
             # for a group that was never enrolled (see enroll()).
             state = recover(state, e, sync_group=group, sync_life=life)
         else:
             life["dispatched_at"] = round(time.perf_counter(), 6)
-            enroll(out, staged, group, cursor_before, life)
+            enroll(out, stats, staged, group, cursor_before, life)
             state = out
         if (checkpoint_every and checkpoint_path
                 and step_index // checkpoint_every > last_ckpt):
@@ -811,9 +863,14 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
 
     ``telemetry`` (:class:`...obs.telemetry.Telemetry`, optional): per-step
     run-ledger records, flight-recorder forensics on failure, and metrics-
-    registry counters for the run.  ``None`` disables all of it at zero
-    per-step cost.  The caller owns the handle's lifetime (``tel.close()``
-    flushes the ledger).
+    registry counters for the run.  For jobs with data-stats hooks
+    (the wordcount family) a telemetered run also runs the engine in
+    stats mode (ISSUE 8): per-group data-plane counters ride the
+    ``group`` records and one per-run ``data`` summary record lands —
+    results stay byte-identical.  ``None`` disables all of it at zero
+    per-step cost and keeps the exact uninstrumented step programs.  The
+    caller owns the handle's lifetime (``tel.close()`` flushes the
+    ledger).
 
     ``retry``: retries per step group on a transient dispatch failure.  The
     device state is donated into each step, so with ``retry > 0`` the
@@ -847,8 +904,18 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     # axes row-major; hierarchical merge reduces innermost-first).
     axes = tuple(mesh.axis_names)
     n_dev = mesh.size  # == product over all axes, which we shard in full
+    # Data-plane telemetry (ISSUE 8): telemetered runs of jobs with stats
+    # hooks run the engine in stats mode — each step also returns a tiny
+    # DataStats pytree fetched at group retirement and folded into the
+    # `group` records + the per-run `data` summary record.  Results stay
+    # byte-identical; telemetry=None keeps the exact pre-ISSUE-8 programs.
+    from mapreduce_tpu.ops import datastats as datastats_ops
+
+    data_stats = tel.enabled and datastats_ops.supports(job)
     engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
-                    merge_strategy=merge_strategy)
+                    merge_strategy=merge_strategy, data_stats=data_stats)
+    data_agg = datastats_ops.DataAggregator.for_run(config, n_dev) \
+        if data_stats else None
     range_lo, range_hi = byte_range if byte_range is not None else (0, None)
 
     timer = metrics_mod.PhaseTimer()
@@ -928,7 +995,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             fingerprint=fingerprint, resumed_file=resumed_file,
             logger=logger, progress_every=progress_every, timer=timer,
-            telemetry=tel)
+            telemetry=tel, data_agg=data_agg)
         # Residual drain: the stream loop already retired every in-flight
         # group (h2d_tail/compute_tail decompose what this phase used to
         # lump together); this keeps the stream/reduce boundary honest.
@@ -950,6 +1017,14 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     total_s = timer.stop("total")
 
     _finalize_pipeline(pipe, timer, tel)
+    if data_agg is not None and data_agg.groups:
+        # One per-run data-plane summary record (ISSUE 8) — written before
+        # run_end so "no run_end = did not complete" stays the last-record
+        # invariant.  obs/datahealth.py classifies this dict; the window
+        # autotuner (ROADMAP item 1) reads it next to the PR-7 bottleneck.
+        data_rec = data_agg.run_record()
+        tel.ledger_write("data", **data_rec)
+        tel.note_data(data_rec)
     words = _metrics_word_count(value)
     # bytes_done is the absolute resume CURSOR (checkpoints store it); the
     # throughput metric counts only bytes this run actually streamed.
@@ -1009,6 +1084,10 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     mesh = mesh if mesh is not None else dist.global_data_mesh()
     axes = tuple(mesh.axis_names)
     n_dev = mesh.size
+    # No data-stats mode here (like no retry): the stats leaves are [D]
+    # per-shard scalars, and fetching them on a mesh spanning other
+    # processes' devices would need a collective round per retirement.
+    # Data-plane telemetry is the per-host-driven / single-host story.
     engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
                     merge_strategy=merge_strategy)
     mine = np.asarray(dist.host_shards(n_dev), dtype=np.int64)

@@ -287,3 +287,51 @@ def test_inflight_and_prefetch_depth_flags_validate(tmp_path):
         r = _run(args)
         assert r.returncode == 2, args
         assert "must be >= 1" in r.stderr, args
+
+
+def test_batch_ledger_without_stream(tmp_path, capsys):
+    """ISSUE 8 satellite: --ledger/--metrics-out no longer require
+    --stream.  A batch (single-buffer) run emits run_start + a
+    result-derived data record + run_end, and the registry snapshot
+    lands; the ledger classifies through obs_report's data-health path.
+    In-process (no subprocess jax startup): the tier-1 budget rule."""
+    import sys as _sys
+
+    from mapreduce_tpu import cli
+
+    _sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import obs_report
+    finally:
+        _sys.path.pop(0)
+    f = tmp_path / "in.txt"
+    f.write_text("aa bb aa cc aa\n")
+    led = tmp_path / "run.jsonl"
+    met = tmp_path / "metrics.json"
+    assert cli.main([str(f), "--no-echo", "--format", "json",
+                     "--ledger", str(led), "--metrics-out",
+                     str(met)]) == 0
+    capsys.readouterr()
+    recs = obs_report.read_ledger(str(led))
+    assert [x["kind"] for x in recs] == ["run_start", "data", "run_end"]
+    start, data, end = recs
+    assert start["driver"] == "single_buffer" and start["job"] == "wordcount"
+    assert start["ledger_version"] == 3
+    assert data["tokens"] == 5 and data["table_valid"] == 3
+    assert data["top_count"] == 3 and data["dropped_tokens"] == 0
+    assert end["words"] == 5 and end["elapsed_s"] > 0
+    assert json.loads(met.read_text())  # registry snapshot written
+    runs = obs_report.analyze(str(led))
+    assert len(runs) == 1 and runs[0]["completed"]
+    # top mass 3/5: the report's data-health section classifies it.
+    assert runs[0]["data_health"]["verdict"] == "skew-hot"
+    # Batch grep runs bracket the ledger too (run_start/run_end; no data
+    # record — grep has no table to summarize).
+    g = tmp_path / "g.txt"
+    g.write_text("abc abc\nxyz\n")
+    gled = tmp_path / "grep.jsonl"
+    assert cli.main([str(g), "--grep", "abc", "--ledger", str(gled)]) == 0
+    capsys.readouterr()
+    grecs = obs_report.read_ledger(str(gled))
+    assert [x["kind"] for x in grecs] == ["run_start", "run_end"]
+    assert grecs[0]["job"] == "grep" and grecs[1]["words"] == 2

@@ -559,3 +559,80 @@ def test_fusion_pass_keeps_write_for_fanout_intermediate(mesh8):
     assert pair, _fusion_candidates(report, "fanout-inter")
     # Read saved, write preserved: 1x the intermediate, not 2x.
     assert pair[0]["hbm_bytes_saved"] == pair[0]["intermediate_bytes"], pair
+
+
+# -- telemetry-overhead cost gate (ISSUE 8) ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def telemetry_ctx(mesh8):
+    """Context for the data-stats-instrumented stable2 model: the traced
+    step is the INSTRUMENTED program telemetered runs dispatch."""
+    job = models_mod.build_model("wordcount_telemetry")
+    return acore.AnalysisContext(job, "wordcount_telemetry", mesh=mesh8)
+
+
+@pytest.mark.smoke
+def test_cost_gate_certifies_telemetry_overhead(telemetry_ctx):
+    """ISSUE 8 acceptance: the instrumented model prices within 1% of the
+    uninstrumented twin's checked-in baseline, and the artifact carries
+    the measured overhead."""
+    report = acore.run_pipeline(telemetry_ctx, [CostPass()])
+    assert not report.errors, report.format_text()
+    art = report.artifacts["wordcount_telemetry"]["cost"]
+    ov = art["telemetry_overhead"]
+    assert ov["plain_model"] == "wordcount_pallas"
+    assert abs(ov["overhead_frac"]) <= ov["tolerance"] == 0.01, ov
+    assert ov["instrumented_effective_input_passes"] \
+        >= ov["plain_effective_input_passes"], \
+        "instrumentation can only add traffic"
+    assert any("telemetry overhead certified" in f.message
+               for f in report.findings)
+
+
+def test_cost_gate_flags_telemetry_overhead_regression(mesh8, tmp_path,
+                                                       telemetry_ctx):
+    """A plain baseline priced well below the instrumented program =
+    observability grew the HBM bill past the 1% gate: ERROR."""
+    if "cost" not in telemetry_ctx.artifacts:
+        acore.run_pipeline(telemetry_ctx, [CostPass()])
+    instr = telemetry_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = telemetry_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_pallas.json").write_text(json.dumps(
+        {"model": "wordcount_pallas",
+         "effective_input_passes": instr / 1.5,
+         "traced_chunk_bytes": chunk}))
+    # Own regression baseline stays clean so only the overhead gate fires.
+    (tmp_path / "wordcount_telemetry.json").write_text(json.dumps(
+        {"model": "wordcount_telemetry",
+         "effective_input_passes": instr,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(telemetry_ctx.job, "wordcount_telemetry",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = telemetry_ctx.engine_traces  # reuse the trace
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("observability is regressing" in f.message for f in errs), \
+        report.format_text()
+    assert report.exit_code != 0
+
+
+def test_cost_gate_flags_missing_plain_counterpart(mesh8, tmp_path,
+                                                   telemetry_ctx):
+    """No uninstrumented baseline = the overhead cannot be gated: ERROR
+    (mirrors the fused gate's missing-counterpart contract)."""
+    if "cost" not in telemetry_ctx.artifacts:
+        acore.run_pipeline(telemetry_ctx, [CostPass()])
+    instr = telemetry_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = telemetry_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_telemetry.json").write_text(json.dumps(
+        {"model": "wordcount_telemetry",
+         "effective_input_passes": instr,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(telemetry_ctx.job, "wordcount_telemetry",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = telemetry_ctx.engine_traces
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("telemetry overhead cannot be gated" in f.message
+               for f in errs), report.format_text()

@@ -1,0 +1,356 @@
+"""Data-plane telemetry tests (ISSUE 8): on-device spill/rescue/skew/
+occupancy counters threaded from the map path through the Engine's stats
+mode into `group`/`data` ledger records, the host-side aggregator's
+arithmetic, the jax-free data-health classifier, byte-identity of
+telemetered results, the per-group overhead bound, and the flight
+recorder's data snapshot (fused map path included)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu import obs
+from mapreduce_tpu.config import Config
+from mapreduce_tpu.models.wordcount import WordCountJob
+from mapreduce_tpu.obs import datahealth
+from mapreduce_tpu.ops import datastats
+from mapreduce_tpu.parallel.mesh import data_mesh
+from mapreduce_tpu.runtime import executor
+
+from conftest import make_corpus
+
+CFG = Config(chunk_bytes=512, table_capacity=2048)
+
+
+def _streamed(tmp_path, corpus: bytes, cfg=CFG, telemetry=True, name="c"):
+    path = tmp_path / f"{name}.txt"
+    path.write_bytes(corpus)
+    if not telemetry:
+        rr = executor.run_job(WordCountJob(cfg), str(path), cfg,
+                              mesh=data_mesh(4))
+        return rr, None
+    led = str(tmp_path / f"{name}.jsonl")
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        rr = executor.run_job(WordCountJob(cfg), str(path), cfg,
+                              mesh=data_mesh(4), telemetry=tel)
+    return rr, list(obs.read_ledger(led))
+
+
+@pytest.fixture(scope="module")
+def zipf_run(tmp_path_factory, rng):
+    """One telemetered streamed run over a Zipf corpus (module-scoped:
+    streamed CPU runs are the expensive part of this module)."""
+    tmp = tmp_path_factory.mktemp("ds_zipf")
+    corpus = make_corpus(np.random.default_rng(20260804), 2500, 150)
+    rr, recs = _streamed(tmp, corpus)
+    return corpus, rr, recs, tmp
+
+
+# -- executor emission -------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_data_record_and_group_data(zipf_run):
+    """ISSUE 8 tentpole: every retired group's record carries its data
+    dict, exactly one per-run `data` record lands before run_end, and its
+    totals agree with the RESULT's own accounting (tokens and dropped are
+    the same numbers the recovered WordCountResult reports)."""
+    corpus, rr, recs, _ = zipf_run
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("data") == 1
+    assert kinds.index("data") < kinds.index("run_end")
+    groups = [r for r in recs if r["kind"] == "group"]
+    assert groups and all("data" in g for g in groups)
+    for g in groups:
+        assert g["data"]["chunks"] >= 1
+        assert 0.0 <= g["data"]["occupancy"] <= 1.0
+    data = next(r for r in recs if r["kind"] == "data")
+    # Totals vs the merged result: tokens (incl. dropped) and dropped
+    # accounting must be the very numbers the result carries.
+    tbl = rr.value
+    assert data["tokens"] == int(np.asarray(tbl.total_count()))
+    du, dc = tbl.dropped_totals()
+    assert data["dropped_tokens"] == dc and data["dropped_cumulative"] == dc
+    assert data["dropped_uniques"] == du
+    # One chunk mapped per device per step.
+    steps = sum(r["steps"] for r in recs if r["kind"] == "step")
+    assert data["chunks"] == 4 * steps
+    assert data["groups"] == len(groups)
+    assert data["backend"] == "xla" and data["map_impl"] == "split"
+    # capacity = per-device capacity x devices; occupancy consistent.
+    assert data["capacity"] == 2048 * 4
+    assert data["table_occupancy"] == round(
+        data["table_valid"] / data["capacity"], 4)
+    # Zipf corpus: the top key carries a fat share; gauges reflect it.
+    assert data["top_count"] > 0 and data["top_mass"] > 0.05
+
+
+@pytest.mark.smoke
+def test_results_byte_identical_with_telemetry(zipf_run, tmp_path):
+    """ISSUE 8 acceptance: data telemetry ON changes the step program's
+    outputs (a stats pytree rides along) but never the results — the
+    merged state is byte-identical to the untelemetered run."""
+    import jax
+
+    corpus, rr, _, _ = zipf_run
+    rr2, _ = _streamed(tmp_path, corpus, telemetry=False)
+    a, b = jax.tree.leaves(rr.value), jax.tree.leaves(rr2.value)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
+def test_zipf_vs_uniform_health_verdicts(zipf_run, tmp_path):
+    """ISSUE 8 acceptance: a Zipf-hot-key run and a uniform run produce
+    DISTINGUISHABLE data-health verdicts from the ledger alone, on the
+    CPU path (no device hardware)."""
+    _, _, zipf_recs, _ = zipf_run
+    zipf_health = datahealth.classify_run(zipf_recs)
+    assert zipf_health is not None
+    assert any(f["flag"] == "skew-hot" for f in zipf_health["flags"]), \
+        zipf_health
+    # Uniform corpus: every word equally likely -> top mass ~ 1/vocab.
+    uniform = " ".join(f"u{i % 150:03x}" for i in range(2500)).encode()
+    _, recs = _streamed(tmp_path, uniform, name="uniform")
+    uni_health = datahealth.classify_run(recs)
+    assert uni_health is not None
+    assert uni_health["verdict"] == "clean", uni_health
+    assert uni_health["signals"]["top_mass"] < 0.02
+    assert zipf_health["signals"]["top_mass"] \
+        > 5 * uni_health["signals"]["top_mass"]
+
+
+def test_registry_carries_data_instruments(zipf_run):
+    """Retirement mirrors the data counters/gauges into the registry
+    (`data.*`), next to the PR-7 lifecycle instruments."""
+    _, _, _, _ = zipf_run
+    snap = obs.get_registry().snapshot()
+    assert "data.table_occupancy" in snap["gauges"]
+    assert "data.top_mass" in snap["gauges"]
+    assert 0.0 < snap["gauges"]["data.top_mass"] <= 1.0
+
+
+def test_group_record_with_data_overhead_under_1ms(tmp_path):
+    """ISSUE 8 acceptance (extends the PR-7 bound): the full per-group
+    retirement path — host stats reduce + aggregator fold + registry +
+    group record with data + JSONL append — averages far under 1 ms."""
+    led = str(tmp_path / "overhead.jsonl")
+    n = 300
+    agg = datastats.DataAggregator(capacity=2048, devices=4, backend="xla",
+                                   map_impl="split")
+    host = datastats.DataStats(*[np.ones(4, np.uint32) for _ in
+                                 datastats.DataStats._fields])
+    with obs.Telemetry.create(ledger_path=led) as tel:
+        t0 = time.perf_counter()
+        for i in range(n):
+            life = {"step_first": i, "step_last": i, "steps": 1,
+                    "group_bytes": 2048,
+                    "staged_at": time.perf_counter(),
+                    "dispatched_at": time.perf_counter()}
+            data = agg.group_data(host)
+            tel.note_data(agg.snapshot())
+            executor._group_record(tel, True, life,
+                                   token_ready_at=life["staged_at"] + 0.01,
+                                   retired_at=life["staged_at"] + 0.011,
+                                   wait_s=0.005, data=data)
+        dt = time.perf_counter() - t0
+    assert dt / n < 1e-3, f"{1e3 * dt / n:.3f} ms per group with data"
+    recs = list(obs.read_ledger(led, kind="group"))
+    assert len(recs) == n and all("data" in r for r in recs)
+
+
+# -- aggregator arithmetic ---------------------------------------------------
+
+
+def test_aggregator_hand_arithmetic():
+    """DataAggregator against arithmetic done by hand: counters sum over
+    devices AND groups, 64-bit lane pairs reconstruct exactly, the top
+    count is the cross-device max, and window occupancy divides tokens by
+    chunks x slot capacity."""
+    agg = datastats.DataAggregator(capacity=1000, devices=2, backend="pallas",
+                                   map_impl="split",
+                                   slot_capacity_per_chunk=1000)
+
+    def stats(**kw):
+        vals = {f: np.zeros(2, np.uint32) for f in datastats.DataStats._fields}
+        for k, v in kw.items():
+            vals[k] = np.asarray(v, np.uint32)
+        return datastats.DataStats(**vals)
+
+    g1 = agg.group_data(stats(chunks=[1, 1], overlong=[3, 4],
+                              rescued=[2, 3], dropped_tokens=[1, 1],
+                              fallback_chunks=[1, 0], spill_rows=[10, 0],
+                              table_valid=[100, 200],
+                              total_lo=[500, 600], top_lo=[50, 90],
+                              dropped_lo=[1, 1]))
+    assert g1["chunks"] == 2 and g1["overlong"] == 7 and g1["rescued"] == 5
+    assert g1["fallback_chunks"] == 1 and g1["spill_rows"] == 10
+    assert g1["occupancy"] == round(300 / 2000, 4)
+    assert g1["top_mass"] == round(90 / 1100, 6)
+    # A 64-bit gauge: hi lane = 1 -> +2**32 on that device.
+    g2 = agg.group_data(stats(chunks=[1, 1], table_valid=[150, 250],
+                              total_lo=[700, 800], total_hi=[1, 0],
+                              top_lo=[60, 95], dropped_lo=[2, 2]))
+    assert g2["chunks"] == 2
+    rec = agg.run_record()
+    assert rec["chunks"] == 4 and rec["groups"] == 2
+    assert rec["overlong"] == 7 and rec["rescued"] == 5
+    assert rec["tokens"] == 700 + 800 + (1 << 32)
+    assert rec["top_count"] == 95 and rec["table_valid"] == 400
+    assert rec["dropped_cumulative"] == 4
+    assert rec["table_occupancy"] == round(400 / 2000, 4)
+    # 4 chunks x 1000 slots; tokens >> would mean dense windows.
+    assert rec["window_slot_capacity"] == 4000
+    assert rec["window_occupancy"] == round(rec["tokens"] / 4000, 4)
+
+
+def test_window_slot_capacity_geometry():
+    """The stable2 window-occupancy denominator from config geometry:
+    blocks(ceil(seg/block_rows)) x 128 lanes x slots; None off the
+    compact pallas path."""
+    cfg = Config(chunk_bytes=128 * 384, table_capacity=512,
+                 backend="pallas")
+    # seg = 384, block_rows = 384 (stable2) -> 1 block x 128 x 128 slots.
+    assert datastats.window_slot_capacity(cfg) == 1 * 128 * 128
+    assert datastats.window_slot_capacity(
+        Config(chunk_bytes=1 << 20, table_capacity=512,
+               backend="xla")) is None
+
+
+# -- classifier rules --------------------------------------------------------
+
+
+def _base_data(**kw):
+    d = {"chunks": 100, "tokens": 100000, "overlong": 0, "rescued": 0,
+         "dropped_tokens": 0, "dropped_uniques": 0, "rescue_invocations": 0,
+         "rescue_escalations": 0, "fallback_chunks": 0, "spill_rows": 0,
+         "table_valid": 5000, "top_count": 900, "capacity": 100000,
+         "table_occupancy": 0.05}
+    d.update(kw)
+    return d
+
+
+def test_classifier_clean_and_each_verdict():
+    assert datahealth.classify(_base_data())["verdict"] == "clean"
+    assert datahealth.classify(_base_data(
+        fallback_chunks=10))["verdict"] == "spill-bound"
+    assert datahealth.classify(_base_data(
+        overlong=500, rescued=400, dropped_tokens=100))["verdict"] \
+        == "rescue-heavy"
+    assert datahealth.classify(_base_data(
+        rescue_escalations=1))["verdict"] == "rescue-heavy"
+    assert datahealth.classify(_base_data(
+        top_count=20000))["verdict"] == "skew-hot"
+    assert datahealth.classify(_base_data(
+        window_occupancy=0.1))["verdict"] == "occupancy-starved"
+    assert datahealth.classify(_base_data(
+        dropped_uniques=5))["verdict"] == "table-pressure"
+    # Priority: spill-bound outranks everything else that fires with it.
+    both = datahealth.classify(_base_data(fallback_chunks=10,
+                                          top_count=20000))
+    assert both["verdict"] == "spill-bound"
+    assert {f["flag"] for f in both["flags"]} == {"spill-bound", "skew-hot"}
+
+
+def test_classifier_tolerates_missing_fields():
+    """Forward compat: an empty/partial/future data record classifies
+    (signals None where underived), never raises."""
+    out = datahealth.classify({})
+    assert out["verdict"] == "clean" and out["signals"]["top_mass"] is None
+    out = datahealth.classify({"tokens": 10, "top_count": 8,
+                               "quantum_flux": object()})
+    assert out["verdict"] == "skew-hot"
+
+
+def test_classify_run_selects_run_and_degrades():
+    recs = [{"kind": "run_start", "run_id": "a"},
+            {"kind": "data", "run_id": "a", "tokens": 100, "top_count": 50,
+             "chunks": 1},
+            {"kind": "data", "run_id": "b", "tokens": 100, "top_count": 1,
+             "chunks": 1}]
+    assert datahealth.classify_run(recs)["verdict"] == "skew-hot"
+    assert datahealth.classify_run(recs, run_id="b")["verdict"] == "clean"
+    assert datahealth.classify_run([{"kind": "step"}]) is None
+
+
+# -- device-side counters (pallas interpret) ---------------------------------
+
+
+@pytest.mark.slow
+def test_map_stream_stats_pallas_counters():
+    """The pallas split path's counters, in interpret mode: one overlong
+    token is detected, the rescue cond fires and recovers it exactly, and
+    the table update stays bit-identical to the stats-off trace."""
+    import jax
+
+    from mapreduce_tpu.models import wordcount as wc
+    from tests.conftest import pallas_interpret_mode
+
+    cfg = Config(chunk_bytes=128 * 66, table_capacity=512, backend="pallas",
+                 compact_slots=88, sort_mode="sort3")
+    data = b"averyoverlongtokenpastthewindowwidthxxxxxx " + b"a b c " * 200
+    padded = wc._pad_for_backend(data, cfg)
+    with pallas_interpret_mode():
+        upd, stats = wc._map_stream(jax.device_put(padded), cfg, 512,
+                                    with_stats=True)
+        plain = wc._map_stream(jax.device_put(padded), cfg, 512)
+    for x, y in zip(jax.tree.leaves(upd), jax.tree.leaves(plain)):
+        assert (np.asarray(x) == np.asarray(y)).all()
+    s = {f: int(np.asarray(v)) for f, v in zip(stats._fields, stats)}
+    assert s["chunks"] == 1
+    assert s["overlong"] == 1 and s["rescued"] == 1
+    assert s["rescue_invocations"] == 1 and s["rescue_escalations"] == 0
+    assert s["dropped_tokens"] == 0 and s["fallback_chunks"] == 0
+
+
+# -- flight recorder: data snapshot on the fused map path --------------------
+
+
+@pytest.mark.slow
+def test_flight_dump_on_fused_path_carries_data_health(tmp_path, rng,
+                                                       monkeypatch):
+    """ISSUE 8 satellite: an injected failure on a FUSED streamed run
+    (today only split-path failures were exercised) leaves a flight dump
+    that carries the data-plane snapshot as of the crash plus its health
+    classification."""
+    from mapreduce_tpu.parallel import mapreduce as mr
+    from tests.conftest import pallas_interpret_mode
+
+    corpus = make_corpus(rng, 6000, 150)
+    path = tmp_path / "c.txt"
+    path.write_bytes(corpus)
+    # 8448 = the pallas minimum chunk at W=32: 2 devices x 8448 per step
+    # puts the injected fault on step 1 with step 0 already retired.
+    cfg = Config(chunk_bytes=8448, table_capacity=2048, backend="pallas",
+                 map_impl="fused", inflight_groups=1)
+    original = mr.Engine.step
+
+    def failing(self, state, chunks, step_index):
+        if step_index >= 1:
+            raise RuntimeError("injected fused fault")
+        return original(self, state, chunks, step_index)
+
+    monkeypatch.setattr(mr.Engine, "step", failing)
+    led = str(tmp_path / "run.jsonl")
+    with pallas_interpret_mode():
+        with obs.Telemetry.create(ledger_path=led) as tel:
+            with pytest.raises(RuntimeError, match="injected fused fault"):
+                executor.run_job(WordCountJob(cfg), str(path), cfg,
+                                 mesh=data_mesh(2), telemetry=tel)
+    dump_path = led + ".flight.json"
+    assert os.path.exists(dump_path)
+    with open(dump_path) as f:
+        dump = json.load(f)
+    # inflight_groups=1: step 0 retired (with its stats) before step 1
+    # failed, so the dump carries the data snapshot up to the crash.
+    assert dump["data"]["groups"] == 1 and dump["data"]["chunks"] == 2
+    assert dump["data"]["map_impl"] == "fused"
+    assert dump["data_health"]["verdict"] in (
+        "clean", "skew-hot", "table-pressure", "occupancy-starved")
+    assert "signals" in dump["data_health"]
+    # The group record written before the crash carries its data dict.
+    groups = [r for r in obs.read_ledger(led, kind="group")]
+    assert len(groups) == 1 and "data" in groups[0]

@@ -33,7 +33,14 @@ def test_random_full_alphabet(config, seed):
     _check(bytes(data), config)
 
 
-@pytest.mark.parametrize("config", [XLA, PALLAS], ids=["xla", "pallas"])
+# pallas id @slow (the ">= ~10 s carries @slow" rebalance, ISSUE 8 round:
+# 27 s — nine interpret-mode kernel executions): the xla sweep keeps every
+# pathology fast-tier, the pallas kernel keeps its randomized fast-tier
+# equivalence via test_backend_oracle_equivalence; the pallas pathology
+# sweep runs in the full suite.
+@pytest.mark.parametrize("config", [
+    pytest.param(XLA, id="xla"),
+    pytest.param(PALLAS, id="pallas", marks=pytest.mark.slow)])
 def test_separator_pathologies(config):
     for data in (b"", b" ", b"   \n\t\r  ", b"\x00\x00\x00", b"x",
                  b" x", b"x ", b"\nx\n", b"a \t\r\n\x0b\x0c b"):

@@ -182,8 +182,19 @@ def test_sharding_pass_accepts_engine_collectives(mesh8):
 # -- clean run over every built-in model (the CI gate) ----------------------
 
 
+@pytest.mark.slow
 def test_all_builtin_models_are_clean(mesh8):
-    """Zero error-severity findings over the whole shipped model zoo."""
+    """Zero error-severity findings over the whole shipped model zoo.
+
+    @slow (the PR-3 ">= ~10 s carries @slow" rebalance, applied when the
+    ISSUE 8 telemetry twins pushed this sweep past 60 s): tier-1 still
+    runs this EXACT gate — ``tools/tier1.sh`` executes ``python -m
+    mapreduce_tpu.analysis --all-models --min-severity error`` before
+    pytest, under its own 240 s budget — so the fast tier keeps the
+    clean-zoo guarantee without paying for it twice; the full suite runs
+    this in-pytest copy for bare-pytest users.  Per-model/per-pass
+    coverage stays fast-tier via the dedicated ctx tests in
+    test_costcheck.py and the known-bad fixtures here."""
     full = analysis.Report()
     for name in models_mod.model_names():
         job = models_mod.build_model(name)

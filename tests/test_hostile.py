@@ -61,10 +61,17 @@ def test_utf8_words_crossing_chunk_seams(tmp_path):
     assert r.as_dict() == oracle.word_counts(text)
 
 
+@pytest.mark.slow
 def test_nul_bearing_input():
     """NUL is a separator (the reference's memset-padding made it one
     implicitly, main.cu:178): embedded NULs split tokens exactly and
-    tokens around them report byte-exact."""
+    tokens around them report byte-exact.
+
+    @slow (the ">= ~10 s carries @slow" rebalance, ISSUE 8 round: 32 s —
+    two fresh unique-shape compiles for a 5-token input): NUL-as-
+    separator stays fast-tier via test_fuzz's separator-pathology sweep
+    (NUL is in its separator set) and the pallas fixture tests; this
+    byte-exact micro case runs in the full suite."""
     data = b"alpha\x00beta \x00\x00 gamma\x00\x00delta alpha"
     r = _agree(data)
     assert r.as_dict() == {b"alpha": 2, b"beta": 1, b"gamma": 1, b"delta": 1}

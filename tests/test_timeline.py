@@ -90,7 +90,7 @@ def test_one_group_record_per_retired_group(piped_ledger):
     assert sum(g["group_bytes"] for g in groups) == corpus_bytes
     # run_start carries the stream schema version (forward-compat anchor).
     start = next(r for r in recs if r["kind"] == "run_start")
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 2
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 3
 
 
 def test_serial_window_is_gap_free_control(serial_ledger):
@@ -260,6 +260,37 @@ def test_future_ledger_skips_unknown_kinds_and_fields():
     assert art is not None and art["groups"] == 1
     trace = timeline.to_chrome_trace(recs)
     assert trace is not None and not trace_export.validate_trace(trace)
+    # The future `data` record (ISSUE 8: extra unknown fields) passes
+    # through read_ledger untouched and classifies — unknown fields
+    # ignored, known signals surfaced.
+    from mapreduce_tpu.obs import datahealth
+
+    data = next(r for r in recs if r["kind"] == "data")
+    assert data["qubit_decoherence"] == 0.4  # unknown field preserved
+    health = datahealth.classify(data)
+    assert health["verdict"] == "skew-hot"  # 48/64 top mass
+    assert health["signals"]["top_mass"] == 0.75
+
+
+def test_chrome_trace_carries_group_data_annotations():
+    """ISSUE 8: group records with `data` dicts export slice args + an
+    instant data marker (spill fallback / rescue escalation) on the
+    device lane; groups without data export exactly as before."""
+    recs = _crafted_records()
+    recs[1]["data"] = {"chunks": 2, "fallback_chunks": 1, "spill_rows": 40,
+                       "occupancy": 0.3}
+    recs[2]["data"] = {"chunks": 2, "occupancy": 0.35}
+    trace = timeline.to_chrome_trace(recs)
+    assert trace_export.validate_trace(trace) == []
+    evs = trace["traceEvents"]
+    marks = [e for e in evs if e["ph"] == "i" and e.get("cat") == "data"]
+    assert len(marks) == 1 and "1 spill fallback" in marks[0]["name"]
+    assert marks[0]["args"]["spill_rows"] == 40
+    with_data = [e for e in evs if e["ph"] == "X"
+                 and "data" in e.get("args", {})]
+    # Group 0 has 4 lifecycle slices (reader/staging/device/retire), group
+    # 2 likewise — both carry the data dict on every slice.
+    assert {e["args"]["data"]["occupancy"] for e in with_data} == {0.3, 0.35}
 
 
 # -- trace export -------------------------------------------------------------

@@ -21,7 +21,18 @@ Reads the JSONL run ledger the executor writes under ``--ledger``
   critical-path ``bottleneck`` verdict (bounding resource + projected
   saving were it infinitely fast) — reconstructed by
   ``mapreduce_tpu/obs/timeline.py``; ``tools/trace_export.py`` renders
-  the same records as a Perfetto-viewable trace.
+  the same records as a Perfetto-viewable trace;
+* the **data health** section (ISSUE 8), when the ledger carries the
+  per-run ``data`` record: on-device spill-fallback / rescue-escalation /
+  dropped-token counters, table occupancy, top-bucket mass (key skew) and
+  stable2 window occupancy, classified by ``mapreduce_tpu/obs/
+  datahealth.py`` into spill-bound / rescue-heavy / skew-hot /
+  occupancy-starved / table-pressure verdicts — the data-shape fitness
+  signal next to the timeline's resource verdict.
+
+``--compare A.jsonl B.jsonl`` diffs two ledgers' phase shares, bound
+classifications, bottleneck verdicts and data-health dicts in one table —
+the render surface for A/B rows (pipeline/nopipeline, fused/split).
 
 Deliberately jax-free and stdlib-only: a wedged TPU box, a laptop, or CI
 can all read the forensics of a run that happened somewhere else (the
@@ -54,32 +65,42 @@ SPIKE_FLOOR_S = 0.05  # ...unless everything is sub-noise fast
 MEM_GROWTH_FACTOR = 1.5  # first->last live-bytes ratio that flags growth
 MEM_GROWTH_FLOOR = 32 << 20  # ...and the absolute delta that makes it real
 
-_TIMELINE = None
+_OBS_MODS: dict = {}
 
 
-def _timeline_mod():
-    """The jax-free timeline reconstructor, loaded by file path from the
-    source tree (importing the package would pull config/jax); falls back
-    to the installed package, and to None when neither exists — the report
-    then simply has no timeline section."""
-    global _TIMELINE
-    if _TIMELINE is None:
+def _obs_mod(name: str):
+    """A jax-free obs module (``timeline``/``datahealth``), loaded by file
+    path from the source tree (importing the package would pull
+    config/jax); falls back to the installed package, and to None when
+    neither exists — the report then simply drops that section."""
+    if name not in _OBS_MODS:
         src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           os.pardir, "mapreduce_tpu", "obs", "timeline.py")
+                           os.pardir, "mapreduce_tpu", "obs", name + ".py")
         try:
             if os.path.exists(src):
                 import importlib.util
 
                 spec = importlib.util.spec_from_file_location(
-                    "_mapreduce_tpu_obs_timeline", src)
+                    f"_mapreduce_tpu_obs_{name}", src)
                 mod = importlib.util.module_from_spec(spec)
                 spec.loader.exec_module(mod)
-                _TIMELINE = mod
+                _OBS_MODS[name] = mod
             else:
-                from mapreduce_tpu.obs import timeline as _TIMELINE
+                import importlib
+
+                _OBS_MODS[name] = importlib.import_module(
+                    f"mapreduce_tpu.obs.{name}")
         except Exception:
-            _TIMELINE = False  # degraded: report without timelines
-    return _TIMELINE or None
+            _OBS_MODS[name] = False  # degraded: report without that section
+    return _OBS_MODS[name] or None
+
+
+def _timeline_mod():
+    return _obs_mod("timeline")
+
+
+def _datahealth_mod():
+    return _obs_mod("datahealth")
 
 
 def read_ledger(path: str):
@@ -293,8 +314,20 @@ def analyze_run(records: list) -> dict:
         if tl is not None:
             timeline = tl.reconstruct(records,
                                       run_id=records[0].get("run_id"))
+    # Data health (ISSUE 8): present only when the run carries a `data`
+    # record AND the classifier is loadable.
+    data = next((r for r in records if r.get("kind") == "data"), None)
+    data_health = None
+    if data is not None:
+        data = {k: v for k, v in data.items()
+                if k not in ("ts", "run_id", "kind")}
+        dh = _datahealth_mod()
+        if dh is not None:
+            data_health = dh.classify(data)
     return {
         "timeline": timeline,
+        "data": data,
+        "data_health": data_health,
         "pipeline": pipeline,
         "overlap_fraction": (pipeline or {}).get("overlap_fraction"),
         "pipeline_flags": pipeline_flags(phases, pipeline),
@@ -390,6 +423,29 @@ def render_run(a: dict, out) -> None:
             out.write("  overlap: " + "  ".join(
                 f"{k}={v:.3f}s" for k, v in
                 sorted(overlaps.items(), key=lambda kv: -kv[1])[:6]) + "\n")
+    d = a.get("data")
+    if d:
+        out.write(f"  data: {d.get('chunks', '?')} chunks, "
+                  f"{d.get('tokens', '?')} tokens")
+        if d.get("dropped_tokens") is not None:
+            out.write(f", dropped {d['dropped_tokens']}")
+        if d.get("overlong"):
+            out.write(f", overlong {d['overlong']} "
+                      f"(rescued {d.get('rescued', 0)})")
+        if d.get("fallback_chunks"):
+            out.write(f", spill fallbacks {d['fallback_chunks']}")
+        if d.get("table_occupancy") is not None:
+            out.write(f", table {100 * d['table_occupancy']:.1f}% full")
+        if d.get("top_mass") is not None:
+            out.write(f", top-mass {100 * d['top_mass']:.2f}%")
+        if d.get("window_occupancy") is not None:
+            out.write(f", windows {100 * d['window_occupancy']:.0f}% full")
+        out.write("\n")
+    health = a.get("data_health")
+    if health:
+        out.write(f"  data health: {health['verdict']}\n")
+        for f in health.get("flags", []):
+            out.write(f"  DATA {f['flag']}: {f['detail']}\n")
     for f in a.get("pipeline_flags", []):
         out.write(f"  PIPELINE {f['flag']}: {f['detail']}\n")
     for f in a.get("map_flags", []):
@@ -410,6 +466,109 @@ def render_run(a: dict, out) -> None:
         out.write(f"  FAILURE at step {f['step']}: {f['error']}\n")
         if f.get("flight_dump"):
             out.write(f"    flight dump: {f['flight_dump']}\n")
+
+
+# -- A/B ledger diffing (ISSUE 8 satellite) ----------------------------------
+
+_STREAMING_PHASES = ("read_wait", "stage", "dispatch", "retire_wait")
+
+
+def _phase_shares(phases: dict) -> dict:
+    total = sum(phases.get(k, 0.0) for k in _STREAMING_PHASES)
+    if total <= 0:
+        return {}
+    return {k: phases.get(k, 0.0) / total for k in _STREAMING_PHASES
+            if phases.get(k)}
+
+
+def _pick_run(runs: list) -> dict | None:
+    """The run a compare reads from one ledger: the LAST completed run
+    (the most recent measurement), else the last run at all."""
+    done = [a for a in runs if a.get("completed")]
+    pool = done or runs
+    return pool[-1] if pool else None
+
+
+def compare_runs(a: dict, b: dict) -> list:
+    """Two analyzed runs -> comparison rows ``[label, A, B, delta]``
+    (delta empty for non-numeric rows).  One table answers the A/B
+    question the queued bench rows ask: where did the seconds move, did
+    the bounding resource change, and did the DATA see the same world."""
+    rows: list = []
+
+    def num(label, va, vb, fmt="{:.4f}"):
+        da = fmt.format(va) if isinstance(va, (int, float)) else "-"
+        db = fmt.format(vb) if isinstance(vb, (int, float)) else "-"
+        dd = fmt.format(vb - va) \
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+            else ""
+        rows.append([label, da, db, dd])
+
+    def text(label, va, vb):
+        rows.append([label, str(va if va is not None else "-"),
+                     str(vb if vb is not None else "-"), ""])
+
+    num("gb_per_s", a.get("gb_per_s"), b.get("gb_per_s"))
+    num("wall_s", a.get("wall_s"), b.get("wall_s"), "{:.3f}")
+    sa, sb = _phase_shares(a.get("phases", {})), \
+        _phase_shares(b.get("phases", {}))
+    for k in _STREAMING_PHASES:
+        if k in sa or k in sb:
+            num(f"{k} share", sa.get(k, 0.0), sb.get(k, 0.0), "{:.0%}")
+    text("bound", a.get("classification"), b.get("classification"))
+    num("overlap_fraction", a.get("overlap_fraction"),
+        b.get("overlap_fraction"), "{:.2f}")
+    bna = (a.get("timeline") or {}).get("bottleneck") or {}
+    bnb = (b.get("timeline") or {}).get("bottleneck") or {}
+    if bna or bnb:
+        text("bottleneck", bna.get("resource"), bnb.get("resource"))
+        num("projected_saving_s", bna.get("projected_saving_s"),
+            bnb.get("projected_saving_s"), "{:.3f}")
+    ha, hb = a.get("data_health") or {}, b.get("data_health") or {}
+    if ha or hb:
+        text("data verdict", ha.get("verdict"), hb.get("verdict"))
+        siga, sigb = ha.get("signals", {}), hb.get("signals", {})
+        for k in ("top_mass", "fallback_frac", "overlong_frac",
+                  "dropped_frac", "table_occupancy", "window_occupancy",
+                  "distinct_ratio"):
+            va, vb = siga.get(k), sigb.get(k)
+            if va is not None or vb is not None:
+                num(k, va, vb, "{:.4f}")
+    return rows
+
+
+def compare(path_a: str, path_b: str, out, as_json: bool = False) -> int:
+    """Diff two ledgers (phase shares, verdicts, data health) in one
+    table; see ``compare_runs``."""
+    a = _pick_run(analyze(path_a))
+    b = _pick_run(analyze(path_b))
+    if a is None or b is None:
+        print("compare: no runs found in "
+              f"{path_a if a is None else path_b}", file=sys.stderr)
+        return 1
+    rows = compare_runs(a, b)
+    if as_json:
+        out.write(json.dumps({
+            "a": {"ledger": path_a, "run_id": a.get("run_id")},
+            "b": {"ledger": path_b, "run_id": b.get("run_id")},
+            "rows": rows,
+            "a_run": a, "b_run": b}) + "\n")
+        return 0
+    name_a = f"A={a.get('run_id')}"
+    name_b = f"B={b.get('run_id')}"
+    out.write(f"compare  A: {path_a} ({a.get('run_id')})  "
+              f"B: {path_b} ({b.get('run_id')})\n")
+    widths = [max(len(r[i]) if i else len(r[0]) for r in rows)
+              for i in range(4)]
+    widths = [max(w, len(h)) for w, h in
+              zip(widths, ("metric", name_a, name_b, "delta"))]
+    header = ["metric", name_a, name_b, "delta"]
+    out.write("  " + "  ".join(h.ljust(w) for h, w in
+                               zip(header, widths)).rstrip() + "\n")
+    for r in rows:
+        out.write("  " + "  ".join(c.ljust(w) for c, w in
+                                   zip(r, widths)).rstrip() + "\n")
+    return 0
 
 
 def render_flight(path: str, out) -> None:
@@ -435,9 +594,10 @@ def selftest() -> int:
     fdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures")
     ledger = os.path.join(fdir, "mini_ledger.jsonl")
+    ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 4, f"fixture holds four runs, got {len(runs)}"
+    assert len(runs) == 5, f"fixture holds five runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -474,8 +634,10 @@ def selftest() -> int:
     assert not c["pipeline_flags"], c["pipeline_flags"]
     cflags = {f["flag"] for f in c["map_flags"]}
     assert cflags == {"fused-map-host-bound"}, cflags
-    # Runs 1-3 predate group records: no timeline section, by design.
+    # Runs 1-3 predate group records: no timeline section, by design —
+    # and predate data records: no data-health section either (ISSUE 8).
     assert a["timeline"] is None and c["timeline"] is None
+    assert a["data"] is None and a["data_health"] is None
     # Run 4 (ISSUE 7): a pipelined run carrying `group` lifecycle records.
     # Constructed reader-bound: two 0.2 s device-idle gaps both covered by
     # the reader lane, and 0.28 s of the 2.02 s span is reader-exclusive —
@@ -497,6 +659,42 @@ def selftest() -> int:
     # The phase classifier agrees with the measured timeline here (both
     # say the reader) — the timeline adds the HOW MUCH the deltas cannot.
     assert d["classification"] == "read-bound", d["classification"]
+    # Run 5 (ISSUE 8): a spill-heavy pallas run carrying per-group `data`
+    # dicts and the per-run `data` record.  Checked against the arithmetic
+    # done by hand on the fixture: 3 of 6 chunks took the full-resolution
+    # fallback (fallback_frac 0.5 > the 5% gate), overlong is 120/60000 =
+    # 0.2% of the stream with one tier-2 escalation, the top key carries
+    # 1500/60000 = 2.5% (NOT skew-hot at the 5% gate), and 20 distinct
+    # keys spilled — so the verdict is spill-bound with rescue-heavy and
+    # table-pressure riding along, and nothing else.
+    e = runs[4]
+    assert e["header"]["ledger_version"] == 3, e["header"]
+    assert e["data"] is not None and e["data"]["fallback_chunks"] == 3
+    eh = e["data_health"]
+    assert eh is not None, "data record must classify"
+    sig = eh["signals"]
+    assert sig["fallback_frac"] == round(3 / 6, 6), sig
+    assert sig["overlong_frac"] == round(120 / 60000, 6), sig
+    assert sig["rescued_frac"] == round(100 / 120, 6), sig
+    assert sig["top_mass"] == round(1500 / 60000, 6), sig
+    assert sig["window_occupancy"] == 0.6104, sig
+    eflags = {f["flag"] for f in eh["flags"]}
+    assert eflags == {"spill-bound", "rescue-heavy", "table-pressure"}, eflags
+    assert eh["verdict"] == "spill-bound", eh["verdict"]
+    # Per-group data dicts ride the group records into the timeline args.
+    egroups = [r for r in read_ledger(ledger)
+               if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
+    assert all("data" in g for g in egroups), egroups
+    # The clean A/B counterpart (mini_ledger_b): uniform corpus, no
+    # fallbacks, top key at 24/60000 = 0.04% — verdict clean; the pair is
+    # the checked-in proof that a hot-key corpus and a uniform one are
+    # DISTINGUISHABLE from the ledger alone.
+    runs_b = analyze(ledger_b)
+    assert len(runs_b) == 1, runs_b
+    f6 = runs_b[0]
+    assert f6["data_health"]["verdict"] == "clean", f6["data_health"]
+    assert not f6["data_health"]["flags"]
+    assert f6["data_health"]["signals"]["top_mass"] == round(24 / 60000, 6)
     # The human renderer must run over all artifacts without raising.
     import io
 
@@ -505,6 +703,7 @@ def selftest() -> int:
     render_run(b, buf)
     render_run(c, buf)
     render_run(d, buf)
+    render_run(e, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert "ANOMALY step-time spike" in body
@@ -518,9 +717,29 @@ def selftest() -> int:
     assert "timeline: 4 groups" in body
     assert "bottleneck: reader" in body
     assert "blocked on: reader 0.400s" in body
+    assert "data health: spill-bound" in body
+    assert "DATA spill-bound" in body and "DATA rescue-heavy" in body
+    assert "spill fallbacks 3" in body
+    # A/B ledger diffing (ISSUE 8 satellite): the spill-heavy run vs the
+    # clean uniform counterpart must render one table naming both data
+    # verdicts, and the machine-readable form must carry the rows.
+    cbuf = io.StringIO()
+    assert compare(ledger, ledger_b, cbuf) == 0
+    ctext = cbuf.getvalue()
+    assert "A=fixture05" in ctext and "B=fixture06" in ctext, ctext
+    assert "data verdict" in ctext and "spill-bound" in ctext \
+        and "clean" in ctext, ctext
+    assert "fallback_frac" in ctext and "top_mass" in ctext, ctext
+    cjson = io.StringIO()
+    assert compare(ledger, ledger_b, cjson, as_json=True) == 0
+    cobj = json.loads(cjson.getvalue())
+    assert cobj["a"]["run_id"] == "fixture05" \
+        and cobj["b"]["run_id"] == "fixture06", cobj
+    assert any(r[0] == "data verdict" for r in cobj["rows"]), cobj["rows"]
     # Ledger forward compat (ISSUE 7 satellite): a future-versioned ledger
     # with unknown kinds and unknown fields must analyze and render
-    # without error, and still surface the facts it does understand.
+    # without error, and still surface the facts it does understand —
+    # including a future-shaped `data` record with extra fields (ISSUE 8).
     fruns = analyze(os.path.join(fdir, "future_ledger.jsonl"))
     assert len(fruns) == 1, fruns
     f = fruns[0]
@@ -528,13 +747,17 @@ def selftest() -> int:
     assert f["completed"] and f["steps"] == 1 and f["bytes"] == 1024
     assert f["timeline"] is not None and f["timeline"]["groups"] == 1, \
         "the malformed future group record must be skipped, not fatal"
+    assert f["data"] is not None and f["data_health"] is not None, \
+        "the future data record must classify (extra fields ignored)"
+    assert f["data_health"]["verdict"] == "skew-hot", f["data_health"]
     render_run(f, io.StringIO())
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
           "1 memory-growth flag, "
           f"{len(a['pipeline_flags']) + len(b['pipeline_flags'])} "
           f"pipeline flags, {len(c['map_flags'])} map flag, "
-          f"timeline bottleneck={bn['resource']}, future-ledger ok)")
+          f"timeline bottleneck={bn['resource']}, "
+          f"data health={eh['verdict']}, compare ok, future-ledger ok)")
     return 0
 
 
@@ -547,13 +770,21 @@ def main(argv=None) -> int:
                          "<ledger>.flight.json that exists)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable analysis instead")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"), default=None,
+                    help="diff two ledgers' phase shares, bound/bottleneck "
+                         "verdicts and data-health dicts in one table "
+                         "(each side uses its last completed run)")
     ap.add_argument("--selftest", action="store_true",
                     help="run against the checked-in fixtures and exit")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.compare:
+        return compare(args.compare[0], args.compare[1], sys.stdout,
+                       as_json=args.json)
     if not args.ledger and not args.flight:
-        ap.error("a ledger path (or --flight, or --selftest) is required")
+        ap.error("a ledger path (or --flight, --compare, or --selftest) "
+                 "is required")
     runs = analyze(args.ledger) if args.ledger else []
     flight = args.flight
     if flight is None and args.ledger \

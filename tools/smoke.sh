@@ -11,7 +11,9 @@
 # and the full suite (no marker filter) the release bar.  Prints
 # DOTS_PASSED like tier1.sh and exits with pytest's status.
 cd "$(dirname "$0")/.." || exit 1
-# The jax-free trace-export selftest (ISSUE 7) costs well under a second
-# and catches fixture/reconstruction drift before any jax import.
+# The jax-free obs_report/trace-export selftests (ISSUE 7/8) cost well
+# under a second each and catch fixture/reconstruction/data-health drift
+# before any jax import.
+timeout -k 5 60 python tools/obs_report.py --selftest || { echo "SMOKE: obs_report selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/trace_export.py --selftest || { echo "SMOKE: trace_export selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_smoke.log; timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'smoke and not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_smoke.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_smoke.log | tr -cd . | wc -c); exit $rc

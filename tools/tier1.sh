@@ -11,16 +11,20 @@
 # registry model through the full pass pipeline — algebra, overflow,
 # host-sync, sharding, hbm-cost (baseline regression + the ISSUE 6
 # fused-vs-split gate: wordcount_fused must price strictly below the
-# split baseline), vmem-budget, kernel-race, fusion-opportunity (INFO
+# split baseline + the ISSUE 8 telemetry gate: the instrumented
+# wordcount_telemetry twins must price within 1% of their uninstrumented
+# baselines), vmem-budget, kernel-race, fusion-opportunity (INFO
 # candidates; a crash or mis-severity would fail here) — plus the
 # production kernel-geometry certification (fused seam-aux geometry
 # included).  Any error-severity finding fails tier-1 before a single
 # test runs.
 cd "$(dirname "$0")/.." || exit 1
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-models --min-severity error || { echo "TIER1: costcheck gate FAILED"; exit 1; }
-# Jax-free reporting-path gates (ISSUE 7 satellite): the obs_report and
-# trace_export selftests run against the checked-in ledger fixtures —
-# the whole ledger -> timeline -> Perfetto-trace path is certified before
-# a single test runs, in seconds.
+# Jax-free reporting-path gates (ISSUE 7/8 satellites): the obs_report
+# and trace_export selftests run against the checked-in ledger fixtures —
+# the whole ledger -> timeline -> Perfetto-trace path, the data-health
+# classifier (spill-heavy fixture vs hand arithmetic), and the --compare
+# A/B diff are certified before a single test runs, in seconds.
+timeout -k 5 60 python tools/obs_report.py --selftest || { echo "TIER1: obs_report selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_export selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -174,6 +174,26 @@ def selftest() -> int:
     assert len(gaps) == 2 and all(e["pid"] == dev_pid for e in gaps)
     # Round-trip: the emitted JSON parses back identically.
     assert json.loads(json.dumps(trace)) == trace
+    # Data-plane annotations (ISSUE 8): the spill-heavy fixture run's
+    # per-group `data` dicts ride the trace — every lifecycle slice's
+    # args carry them, and fallback/escalation groups get instant
+    # markers on the device lane.
+    trace5, art5 = export(ledger, "fixture05")
+    assert art5["groups"] == 2 and not validate_trace(trace5)
+    dmarks = [e for e in trace5["traceEvents"]
+              if e["ph"] == "i" and e.get("cat") == "data"]
+    assert len(dmarks) == 2, dmarks
+    assert all("spill fallback" in e["name"] for e in dmarks), dmarks
+    assert any("rescue escalation" in e["name"] for e in dmarks), dmarks
+    dev5 = next(e["pid"] for e in trace5["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"
+                and e["args"]["name"] == "device")
+    assert all(e["pid"] == dev5 for e in dmarks)
+    dslices = [e for e in trace5["traceEvents"]
+               if e["ph"] == "X" and "data" in e.get("args", {})]
+    assert dslices and all(
+        e["args"]["data"].get("chunks") == 1 for e in dslices), \
+        "slice args must carry the group data dict"
     # Forward compat: the future-versioned fixture must export (or decline
     # with None) without raising, never error.
     future = os.path.join(HERE, "fixtures", "future_ledger.jsonl")
@@ -182,7 +202,7 @@ def selftest() -> int:
     assert not validate_trace(ftrace)
     print(f"trace_export selftest ok ({len(slices)} slices, "
           f"{len(starts)} flows, {len(gaps)} idle markers, "
-          f"bottleneck={bn['resource']})")
+          f"{len(dmarks)} data markers, bottleneck={bn['resource']})")
     return 0
 
 
