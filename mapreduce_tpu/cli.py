@@ -73,6 +73,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: batches the background reader may "
                         "run ahead (default auto: superstep * inflight, "
                         "clamped to [2, 16] — co-tuned with the window)")
+    p.add_argument("--autotune", action="store_true",
+                   help="with --stream: feed the run's own telemetry "
+                        "(timeline bottleneck, data health, window stats) "
+                        "through the config autotuner and fold the "
+                        "recommended next inflight/prefetch/superstep/"
+                        "chunk-bytes into a `tune` ledger record and the "
+                        "run summary — the live run is unchanged; "
+                        "tools/autotune.py walks the loop offline")
     p.add_argument("--stats", action="store_true", help="print timing/throughput to stderr")
     p.add_argument("--retry", type=int, default=0, metavar="N",
                    help="with --stream: retry a failed device step N times "
@@ -418,6 +426,13 @@ def main(argv: list[str] | None = None) -> int:
                      "(--distinct-sketch / --count-sketch / --estimate)")
     if args.checkpoint and not args.stream:
         parser.error("--checkpoint requires --stream")
+    if args.autotune and not args.stream:
+        parser.error("--autotune requires --stream (the single-buffer path "
+                     "has no pipeline knobs to tune)")
+    if args.autotune and (args.grep is not None or args.sample is not None):
+        # The hint path rides run_job's word-count-family summary; grep/
+        # sample streams have no tuner integration yet — honest refusal.
+        parser.error("--autotune applies to word-count runs only")
     if args.retry and not args.stream:
         parser.error("--retry requires --stream (the non-stream path has no "
                      "step dispatch to retry)")
@@ -510,7 +525,8 @@ def main(argv: list[str] | None = None) -> int:
                         compact_slots=args.compact_slots,
                         rescue_overlong=args.rescue_overlong,
                         rescue_overlong_max=args.rescue_overlong_max,
-                        rescue_window=args.rescue_window)
+                        rescue_window=args.rescue_window,
+                        autotune="hint" if args.autotune else "off")
     except ValueError as e:
         parser.error(str(e))
 
@@ -568,8 +584,12 @@ def main(argv: list[str] | None = None) -> int:
     # recorder (--ledger) and the registry snapshot (--metrics-out).  The
     # finally guarantees the snapshot and ledger flush land even when the
     # run itself failed — a crashed telemetered run must leave evidence.
+    # --autotune also forces a handle (ledgerless when --ledger is
+    # absent): the hint is derived from telemetry, and the CLI reports it
+    # from the handle (count_file never returns the RunResult that
+    # carries it).
     tel = None
-    if args.ledger or args.metrics_out:
+    if args.ledger or args.metrics_out or args.autotune:
         from mapreduce_tpu import obs
 
         try:
@@ -598,6 +618,24 @@ def main(argv: list[str] | None = None) -> int:
                     print(f"error: cannot write {args.metrics_out}: {e}",
                           file=sys.stderr)
             tel.close()
+
+
+def _print_tune(telemetry) -> None:
+    """Report the run's autotune recommendation (ISSUE 10) to stderr —
+    the CLI's "run summary" surface for --autotune.  The full record
+    (signals + decision trail) lands in the ledger; stdout stays the
+    reference-parity result."""
+    t = getattr(telemetry, "last_tune", None)
+    if not t:
+        print("autotune: no recommendation (hint path unavailable "
+              "for this run)", file=sys.stderr)
+        return
+    changed = t.get("changed") or {}
+    moves = ", ".join(f"{k} {v[0]} -> {v[1]}" for k, v in changed.items())
+    verdict = "converged" if t.get("converged") else (moves or "no move")
+    print(f"autotune: {t.get('rule')} — {verdict}", file=sys.stderr)
+    if t.get("reason"):
+        print(f"autotune: {t['reason']}", file=sys.stderr)
 
 
 def _resolved_backend_name(config) -> str:
@@ -724,6 +762,9 @@ def _wordcount_main(args, paths, data, config, input_bytes: int,
 
     if args.stats:
         _print_stats(input_bytes, result.total, "words", elapsed)
+
+    if args.autotune:
+        _print_tune(telemetry)
 
     if args.verify_sample:
         from mapreduce_tpu.utils.verify import verify_result

@@ -231,6 +231,18 @@ class Config:
     # superstep * inflight_groups clamped to [2, 16] — enough host-side
     # batches to keep a full window fed without unbounded buffering.
     prefetch_depth: Optional[int] = None
+    # Closed-loop autotuner mode (ISSUE 10).  'off' (default): the knobs
+    # above are what you set.  'hint': the executor feeds the run's OWN
+    # ledger telemetry (the PR-7 `bottleneck` verdict, the PR-8
+    # `data_health` verdict, the window statistics) through the jax-free
+    # rule engine in mapreduce_tpu/tuning/ and folds the recommended next
+    # config for inflight_groups / prefetch_depth / superstep /
+    # chunk_bytes into a `tune` ledger record (ledger v4) and the run
+    # summary — the LIVE run is never changed (apply a hint by re-running
+    # with the proposed flags, or let tools/autotune.py walk the loop
+    # offline).  Hints are a host-local-driver feature like retry and
+    # data stats: run_job_global ignores the knob.
+    autotune: str = "off"
     # Second-tier rescue budget (VERDICT r4 weak #4): URL-heavy text carries
     # ~15K overlong occurrences per 32 MB chunk (tools/overlong.py) — far
     # past the 1024-slot primary budget, which silently left >90% of them
@@ -311,6 +323,9 @@ class Config:
             if self.rescue_window > 4096:
                 raise ValueError(
                     f"rescue_window must be <= 4096, got {self.rescue_window}")
+        if self.autotune not in ("off", "hint"):
+            raise ValueError(f"unknown autotune mode {self.autotune!r} "
+                             "(expected 'off' or 'hint')")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
         if self.inflight_groups < 1:

@@ -38,6 +38,13 @@ data           one per run (ISSUE 8, before run_end): the data-plane
                mass (key-skew proxy), stable2 window occupancy —
                classified by ``obs/datahealth.py`` and consumed by the
                window autotuner next to the timeline verdict
+tune           one per run on ``Config(autotune='hint')`` runs (ISSUE 10,
+               before run_end): the autotuner's recommendation — current
+               vs proposed inflight_groups/prefetch_depth/superstep/
+               chunk_bytes, the fired rule + reason, the signals read
+               (bottleneck resource, projected-saving fraction, data
+               verdict, window stats), and the full rule-by-rule decision
+               trail.  Advisory: the live run is never changed
 checkpoint     step, cursor_bytes, save_s, path
 retry          step, attempt, error
 failure        step, cursor_bytes, error, flight-dump path (if written)
@@ -65,8 +72,10 @@ from typing import Iterator, Optional
 #: version-gate on.  1 = ISSUE 2-6 shape (implicit; pre-ISSUE-7 ledgers
 #: carry no version field at all); 2 = adds ``group`` lifecycle records;
 #: 3 = adds the per-run ``data`` record + per-group ``data`` dicts
-#: (ISSUE 8).
-LEDGER_VERSION = 3
+#: (ISSUE 8); 4 = adds the per-run ``tune`` record (ISSUE 10: the window
+#: autotuner's recommendation + decision trail, ``autotune='hint'`` runs
+#: only).
+LEDGER_VERSION = 4
 
 
 class RunLedger:

@@ -132,6 +132,10 @@ class Telemetry:
         # every group retirement, so a flight dump on the failure path
         # carries the run's data-health snapshot as of the crash.
         self.last_data: Optional[dict] = None
+        # Latest autotune recommendation (ISSUE 10): set once per hint
+        # run, so callers that never see the RunResult (the CLI's
+        # count_file path) can still surface the recommendation.
+        self.last_tune: Optional[dict] = None
         self._last_phases: dict = {}
         self._last_record_t: Optional[float] = None
         self._pending_compiles: list = []
@@ -258,6 +262,13 @@ class Telemetry:
         no I/O, no device work; no-op when disabled."""
         if self.enabled and data is not None:
             self.last_data = data
+
+    def note_tune(self, tune: Optional[dict]) -> None:
+        """Record the run's autotune recommendation (ISSUE 10) so
+        result-dropping call paths (the CLI) can still report it.  A
+        dict assignment; no-op when disabled."""
+        if self.enabled and tune is not None:
+            self.last_tune = tune
 
     def flight_dump(self, context: Optional[dict] = None,
                     state: Any = None) -> Optional[str]:

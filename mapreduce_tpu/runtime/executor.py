@@ -55,6 +55,11 @@ class RunResult:
     # record carries (configured/observed in-flight depth, drain counts,
     # overlap_fraction).  None for drivers that never streamed.
     pipeline: Optional[dict] = None
+    # Config(autotune='hint') runs: the window autotuner's recommendation
+    # for this run (the `tune` ledger record's payload — proposal,
+    # fired rule, decision trail).  None when autotuning is off or the
+    # hint path failed (it is advisory and must never fail the run).
+    tune: Optional[dict] = None
 
 
 def _overlap_fraction(timer) -> Optional[float]:
@@ -82,6 +87,52 @@ def _finalize_pipeline(pipe: dict, timer, tel) -> None:
     if pipe["overlap_fraction"] is not None:
         tel.registry.gauge("executor.overlap_fraction").set(
             pipe["overlap_fraction"])
+
+
+def _autotune_hint(config: Config, tel, pipe: dict, timer,
+                   data_rec: Optional[dict], logger) -> Optional[dict]:
+    """Online autotune hint (ISSUE 10): run the jax-free tuning engine
+    over THIS run's own ledger records and fold the recommendation into a
+    ``tune`` ledger record + the run summary — the live run is never
+    changed.  The records are read back from the run's ledger file (it is
+    flushed per record, and the tuner is a pure function of ledger
+    records — exactly the offline path); with no ledger attached, the
+    in-memory run_end view (phases + window stats + data summary) still
+    yields a phase-classified hint.  Advisory by contract: any failure is
+    logged and swallowed, never surfaced as a run failure."""
+    try:
+        from mapreduce_tpu import tuning
+
+        if tel.enabled and tel.ledger is not None:
+            records = [r for r in obs.read_ledger(tel.ledger.path)
+                       if r.get("run_id") == tel.run_id]
+        else:
+            records = []
+            if data_rec is not None:
+                records.append({"run_id": tel.run_id, "kind": "data",
+                                **data_rec})
+        # run_end is written AFTER the tune record (the "no run_end = did
+        # not complete" invariant): synthesize its view so the proposal
+        # reads this run's phases and window statistics either way.
+        records.append({"run_id": tel.run_id, "kind": "run_end",
+                        "phases": dict(timer.phases), "pipeline": pipe})
+        prop = tuning.propose(records, run_id=tel.run_id, current={
+            "chunk_bytes": config.chunk_bytes,
+            "superstep": config.superstep,
+            "inflight_groups": config.inflight_groups,
+            "prefetch_depth": config.resolved_prefetch_depth})
+        # Belt over the engine's own clamps: a proposal that cannot pass
+        # Config validation must never reach the ledger.
+        tuning.validate_knobs(prop["proposal"], config.backend)
+        prop["mode"] = "hint"
+        tel.ledger_write("tune", **prop)
+        tel.note_tune(prop)
+        log_event(logger, "autotune hint", rule=prop["rule"],
+                  changed=prop["changed"], converged=prop["converged"])
+        return prop
+    except Exception as e:  # noqa: BLE001 — advisory, never fatal
+        log_event(logger, "autotune hint failed", error=repr(e))
+        return None
 
 
 @dataclasses.dataclass
@@ -1017,14 +1068,20 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     total_s = timer.stop("total")
 
     _finalize_pipeline(pipe, timer, tel)
+    data_rec = None
     if data_agg is not None and data_agg.groups:
         # One per-run data-plane summary record (ISSUE 8) — written before
         # run_end so "no run_end = did not complete" stays the last-record
         # invariant.  obs/datahealth.py classifies this dict; the window
-        # autotuner (ROADMAP item 1) reads it next to the PR-7 bottleneck.
+        # autotuner (ISSUE 10) reads it next to the PR-7 bottleneck.
         data_rec = data_agg.run_record()
         tel.ledger_write("data", **data_rec)
         tel.note_data(data_rec)
+    # Online autotune hint (ISSUE 10): written after the data record and
+    # before run_end, so the tune record can read everything this run
+    # measured while "no run_end = did not complete" stays true.
+    tune = _autotune_hint(config, tel, pipe, timer, data_rec, logger) \
+        if config.autotune == "hint" else None
     words = _metrics_word_count(value)
     # bytes_done is the absolute resume CURSOR (checkpoints store it); the
     # throughput metric counts only bytes this run actually streamed.
@@ -1033,7 +1090,8 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     tel.ledger_write("run_end", **m.as_dict(), pipeline=pipe)
     log_event(logger, "run complete", **m.as_dict())
     bases = np.stack(bases_list) if bases_list else np.zeros((0, n_dev), np.int64)
-    return RunResult(value=value, metrics=m, bases=bases, pipeline=pipe)
+    return RunResult(value=value, metrics=m, bases=bases, pipeline=pipe,
+                     tune=tune)
 
 
 def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,

@@ -1,0 +1,16 @@
+"""Feedback-driven config autotuner (ISSUE 10): the run ledger's own
+``bottleneck`` + ``data_health`` verdicts -> the next values for
+``inflight_groups`` / ``prefetch_depth`` / ``superstep`` /
+``chunk_bytes``, via a deterministic jax-free rule engine.
+
+Entry points: :func:`propose` (one run's records -> one proposal, the
+online-hint path), :func:`search` (the offline probe-pass walk,
+``tools/autotune.py``).  See :mod:`mapreduce_tpu.tuning.engine`.
+"""
+
+from mapreduce_tpu.tuning.engine import (KNOBS, TUNER_VERSION,
+                                         default_knobs, derive_signals,
+                                         propose, search, validate_knobs)
+
+__all__ = ["KNOBS", "TUNER_VERSION", "default_knobs", "derive_signals",
+           "propose", "search", "validate_knobs"]

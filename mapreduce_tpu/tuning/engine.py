@@ -1,0 +1,505 @@
+"""Closed-loop config autotuner: a run's own ledger -> the next config
+(ISSUE 10 tentpole).
+
+PR 5 built the bounded in-flight dispatch window, PR 7 the measured
+critical-path ``bottleneck`` verdict, PR 8 the ``data_health`` verdict —
+both documented as "the fitness signal the window autotuner reads".  This
+module closes the loop: a **pure, deterministic function of ledger
+records** proposes the next values for the four pipeline knobs
+
+    ``inflight_groups`` / ``prefetch_depth`` / ``superstep`` /
+    ``chunk_bytes``
+
+via a verdict-keyed rule table (below), in the spirit of CUDA-LLM's
+search-loop-with-a-certifier-as-fitness-gate and the config-search framing
+of "Synthesizing Optimal Parallelism Placement and Reduction Strategies"
+(PAPERS.md).  Two driving modes consume it:
+
+* **offline search** (``tools/autotune.py``): :func:`search` walks the
+  rule table over N short streamed probe passes until converged, budget-
+  exhausted, or the oscillation guard trips, emitting a ``tuned.json``
+  profile keyed by (family, backend, corpus shape);
+* **online hints** (``Config(autotune='hint')`` / CLI ``--autotune``):
+  the executor calls :func:`propose` on the run's own records and folds
+  the recommendation into a ``tune`` ledger record (ledger v4) and the
+  run summary — the live run itself is never changed.
+
+The rule table (first match wins; every raising rule converges at its
+cap instead of proposing a no-op):
+
+==================  =======================================  ============
+rule                trigger                                  move
+==================  =======================================  ============
+no-signal           no phases/pipeline/timeline at all       stop
+grow-chunk          data verdict ``occupancy-starved``       chunk ×2
+shrink-chunk        data verdict ``table-pressure``          chunk ÷2
+converged           projected bottleneck saving < 10% span   stop
+raise-prefetch      bottleneck resource ``reader``           prefetch ×2
+feed-window         h2d/staging-bound, window never filled   prefetch ×2
+raise-inflight      bottleneck ``h2d`` or ``staging``        inflight ×2
+try-superstep       device-bound AND window always full      superstep ×2
+device-bound        device-bound, window not saturated       stop
+no-rule             nothing actionable (e.g. ``retire``)     stop
+==================  =======================================  ============
+
+Data-shape verdicts whose knobs are OUTSIDE the tuned set (spill-bound →
+``--compact-slots``, rescue-heavy → the rescue budgets, skew-hot → merge
+strategy) are noted in the decision trail but never produce a move: the
+tuner must not thrash pipeline knobs to chase a data problem.  The
+``table-pressure`` move is deliberately modest for the same reason — the
+real knob is ``--table-capacity``, which is not tuned here; halving the
+chunk shrinks the per-merge batch table that competes for slots, and the
+reason string says so.
+
+Every proposal is validated through the real ``Config.__post_init__``
+rules (:func:`validate_knobs`), and — in the offline driver — every
+ACCEPTED step still runs through the costcheck gate before it can touch
+a device (``tools/autotune.py``).  The whole module is deliberately
+jax-free (it imports only the jax-free corners of the package: config
+validation, ``obs/timeline``, ``obs/datahealth``), so it unit-tests
+against synthetic ledgers exactly like ``timeline.py``/``datahealth.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.obs import datahealth, timeline
+
+#: Bumped when the rule table / proposal schema changes shape.
+TUNER_VERSION = 1
+
+#: The knobs this tuner owns, in proposal order.
+KNOBS = ("inflight_groups", "prefetch_depth", "superstep", "chunk_bytes")
+
+# Move envelopes.  The caps are the measured/documented envelopes, not
+# arbitrary: prefetch's auto-resolution clamps at 16 (Config), a >16-deep
+# window holds >16 chunks of staged input live (the documented memory
+# cost), superstep 32 at the default chunk stages 1 GB per device per
+# dispatch, and chunk_bytes beyond 64 MB is refused by the pallas packing
+# envelope while below 1 MB dispatch overhead dominates (BENCHMARKS.md
+# round 4).
+INFLIGHT_MAX = 16
+PREFETCH_MAX = 16
+SUPERSTEP_MAX = 32
+CHUNK_MIN = 1 << 20
+CHUNK_MAX = 1 << 26
+
+#: A bottleneck whose projected saving is below this share of the span is
+#: not worth a config move: the pipeline is within 10% of its overlap
+#: ceiling and further moves chase noise.
+CONVERGED_SAVING_FRAC = 0.10
+#: ``full_frac`` at or above this = the window hit capacity on nearly
+#: every dispatch (the obs_report "always-full" gate).
+ALWAYS_FULL_FRAC = 0.9
+
+#: Data-health verdicts whose knob is outside the tuned set: noted in the
+#: trail, never moved on (verdict -> the knob that actually owns it).
+_FOREIGN_DATA_KNOBS = {
+    "spill-bound": "--compact-slots",
+    "rescue-heavy": "--max-token-bytes / the rescue budgets",
+    "skew-hot": "--merge-strategy (key-range partitioning load-imbalances)",
+}
+
+
+def default_knobs() -> dict:
+    """The shipped defaults as a knob dict (the search starting point)."""
+    return {"inflight_groups": DEFAULT_CONFIG.inflight_groups,
+            "prefetch_depth": DEFAULT_CONFIG.resolved_prefetch_depth,
+            "superstep": DEFAULT_CONFIG.superstep,
+            "chunk_bytes": DEFAULT_CONFIG.chunk_bytes}
+
+
+def validate_knobs(knobs: dict, backend: str = "auto") -> None:
+    """Run a knob dict through the REAL ``Config.__post_init__`` rules
+    (chunk alignment, window/prefetch bounds, backend envelopes) — every
+    proposal must survive this before anything acts on it.  Raises
+    ``ValueError`` exactly as Config would."""
+    if backend not in ("auto", "xla", "pallas"):
+        backend = "auto"  # resolved/CLI names like 'cpu' validate generically
+    Config(chunk_bytes=int(knobs["chunk_bytes"]),
+           superstep=int(knobs["superstep"]),
+           inflight_groups=int(knobs["inflight_groups"]),
+           prefetch_depth=int(knobs["prefetch_depth"]),
+           backend=backend)
+
+
+# -- ledger records -> the signal dict the rule table reads -----------------
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+#: Phase-delta fallback when a run carries no ``group`` records (batch
+#: ledgers, pre-v2 ledgers, the ledgerless hint path): which resource
+#: each streaming phase blames.  ``dispatch`` maps to device — a large
+#: dispatch share means the enqueue blocked on a full device queue (the
+#: obs_report "dispatch-bound" read) — and so do ``retire_wait``,
+#: ``compute_tail`` (queued device work at stream end) and the legacy
+#: ``drain`` they decomposed from.
+_PHASE_LANE = {"read_wait": "reader", "stage": "staging",
+               "dispatch": "device", "retire_wait": "device",
+               "compute_tail": "device", "drain": "device",
+               "h2d_tail": "h2d"}
+
+
+def _phase_resource(phases: dict) -> Optional[str]:
+    lanes: dict = {}
+    for phase, lane in _PHASE_LANE.items():
+        v = _num(phases.get(phase))
+        if v:
+            lanes[lane] = lanes.get(lane, 0.0) + v
+    if not lanes:
+        return None
+    return max(lanes, key=lambda ln: lanes[ln])
+
+
+def derive_signals(records: Iterable[dict],
+                   run_id: Optional[str] = None) -> dict:
+    """One run's ledger records -> the flat signal dict the rule table
+    reads: the run's config knobs (run_start + run_end ``pipeline``), the
+    measured ``bottleneck`` verdict (reconstructed from ``group`` records
+    when present, else a phase-delta fallback), the window statistics,
+    and the data-health classification.  Missing pieces degrade to None —
+    absence of a signal is itself information, never an error (the ledger
+    forward-compat contract)."""
+    records = [r for r in records if isinstance(r, dict)]
+    chosen = run_id
+    if chosen is None:
+        for r in records:
+            if r.get("run_id") is not None:
+                chosen = r.get("run_id")
+                break
+    recs = [r for r in records if r.get("run_id") == chosen]
+    start = next((r for r in recs if r.get("kind") == "run_start"), None)
+    end = next((r for r in recs if r.get("kind") == "run_end"), None)
+    phases = dict((end or {}).get("phases") or {})
+    if not phases:  # crashed run: fold the step deltas that DID land
+        for r in recs:
+            if r.get("kind") == "step":
+                for k, v in (r.get("phases") or {}).items():
+                    if _num(v) is not None:
+                        phases[k] = phases.get(k, 0.0) + float(v)
+    pipeline = (end or {}).get("pipeline") or None
+
+    config: dict = {}
+    for key in ("chunk_bytes", "superstep"):
+        v = _num((start or {}).get(key))
+        if v is not None:
+            config[key] = int(v)
+    for key in ("inflight_groups", "prefetch_depth"):
+        v = _num((pipeline or {}).get(key))
+        if v is not None:
+            config[key] = int(v)
+
+    art = timeline.reconstruct(recs, run_id=chosen)
+    bottleneck = art["bottleneck"] if art else None
+    resource = source = None
+    saving_frac = None
+    if bottleneck:
+        resource, source = bottleneck.get("resource"), "timeline"
+        span = _num(bottleneck.get("span_s"))
+        saving = _num(bottleneck.get("projected_saving_s"))
+        if span and saving is not None:
+            saving_frac = round(saving / span, 4)
+    elif phases:
+        resource, source = _phase_resource(phases), "phases"
+
+    gb_per_s = _num((end or {}).get("gb_per_s"))
+    if gb_per_s is None:
+        b, el = _num((end or {}).get("bytes")), \
+            _num((end or {}).get("elapsed_s"))
+        if b and el:
+            gb_per_s = round(b / 1e9 / el, 6)
+
+    health = datahealth.classify_run(recs, run_id=chosen)
+    return {
+        "run_id": chosen,
+        "gb_per_s": gb_per_s,
+        "config": config,
+        "backend": (start or {}).get("backend"),
+        "phases": phases,
+        "pipeline": pipeline,
+        "bottleneck": bottleneck,
+        "resource": resource,
+        "resource_source": source,
+        "saving_frac": saving_frac,
+        "overlap_fraction": _num((pipeline or {}).get("overlap_fraction")),
+        "depth_max": _num((pipeline or {}).get("depth_max")),
+        "full_frac": _num((pipeline or {}).get("full_frac")),
+        "data_health": health,
+        "data_verdict": (health or {}).get("verdict"),
+    }
+
+
+# -- the rule table ----------------------------------------------------------
+
+def propose(records: Iterable[dict], run_id: Optional[str] = None,
+            current: Optional[dict] = None) -> dict:
+    """Ledger records -> the next-config proposal: a pure, deterministic
+    function (same records in, same proposal out — the unit-test
+    contract).  ``current`` overrides the knob values derived from the
+    records (the search loop knows what it actually ran; a ledger may
+    predate a knob).
+
+    Returns a dict with ``current``/``proposal`` (all four knobs),
+    ``changed`` (knob -> [old, new]), the fired ``rule`` + human
+    ``reason``, ``converged``, the compact ``signals`` the rules read,
+    and ``trail`` — every rule CONSIDERED, in order, with whether it
+    fired and why (the machine-readable decision trail).
+    """
+    sig = derive_signals(records, run_id)
+    cur = default_knobs()
+    cur.update({k: v for k, v in sig["config"].items() if k in cur})
+    if current:
+        cur.update({k: int(v) for k, v in current.items() if k in cur})
+
+    trail: List[dict] = []
+
+    def consider(rule: str, fired: bool, why: str) -> bool:
+        trail.append({"rule": rule, "fired": fired, "why": why})
+        return fired
+
+    def result(rule: str, reason: str, changes: Optional[dict] = None,
+               converged: bool = False) -> dict:
+        prop = dict(cur)
+        changed = {}
+        for k, v in (changes or {}).items():
+            v = int(v)
+            if v != cur[k]:
+                changed[k] = [cur[k], v]
+                prop[k] = v
+        return {
+            "tuner_version": TUNER_VERSION,
+            "run_id": sig["run_id"],
+            "current": cur,
+            "proposal": prop,
+            "changed": changed,
+            "rule": rule,
+            "reason": reason,
+            "converged": bool(converged or not changed),
+            "signals": {k: sig[k] for k in
+                        ("resource", "resource_source", "saving_frac",
+                         "overlap_fraction", "depth_max", "full_frac",
+                         "data_verdict", "gb_per_s")},
+            "trail": trail,
+        }
+
+    resource = sig["resource"]
+    saving = sig["saving_frac"]
+    verdict = sig["data_verdict"]
+    depth_max = sig["depth_max"]
+    full_frac = sig["full_frac"]
+
+    # 1. Nothing to read at all: a run with no phases, no pipeline stats
+    #    and no timeline gives the rules nothing — stop honestly.
+    if consider("no-signal",
+                not sig["phases"] and sig["pipeline"] is None
+                and sig["bottleneck"] is None,
+                "no phases, pipeline stats or timeline in the ledger"):
+        return result("no-signal", "no telemetry to tune from",
+                      converged=True)
+
+    # 2-3. Data-shape rules outrank pipeline rules: a wrong chunk geometry
+    #    poisons every overlap signal downstream of it.
+    if consider("grow-chunk",
+                verdict == "occupancy-starved"
+                and cur["chunk_bytes"] * 2 <= CHUNK_MAX,
+                f"data verdict {verdict!r}; chunk {cur['chunk_bytes']}"):
+        return result("grow-chunk",
+                      "compact kernel windows ran mostly empty "
+                      "(occupancy-starved): double chunk_bytes so each "
+                      "window sees denser input instead of sorting padding",
+                      {"chunk_bytes": cur["chunk_bytes"] * 2})
+    if consider("shrink-chunk",
+                verdict == "table-pressure"
+                and cur["chunk_bytes"] // 2 >= CHUNK_MIN
+                and (cur["chunk_bytes"] // 2) % 128 == 0,
+                f"data verdict {verdict!r}; chunk {cur['chunk_bytes']}"):
+        return result("shrink-chunk",
+                      "running table near capacity (table-pressure): halve "
+                      "chunk_bytes so smaller per-merge batch tables "
+                      "compete for slots — the real knob is "
+                      "--table-capacity, which is not autotuned",
+                      {"chunk_bytes": cur["chunk_bytes"] // 2})
+    if verdict in _FOREIGN_DATA_KNOBS:
+        consider(f"data-{verdict}", False,
+                 f"data verdict {verdict!r} noted; its knob "
+                 f"({_FOREIGN_DATA_KNOBS[verdict]}) is outside the tuned "
+                 "set — pipeline rules proceed")
+
+    # 4. Converged: the measured critical path says an infinitely fast
+    #    bounding resource would save <10% of the span — the pipeline is
+    #    at its overlap ceiling; further knob moves chase noise.
+    if consider("converged",
+                saving is not None and saving < CONVERGED_SAVING_FRAC,
+                f"projected saving {saving} of span"
+                if saving is not None else "no timeline saving measured"):
+        return result("converged",
+                      f"bottleneck {resource!r} projects only "
+                      f"{saving:.0%} of the span recoverable "
+                      f"(< {CONVERGED_SAVING_FRAC:.0%}): converged",
+                      converged=True)
+
+    # 5. Reader-bound: the prefetching reader starves the pipeline.
+    if resource == "reader":
+        if consider("raise-prefetch", cur["prefetch_depth"] * 2
+                    <= PREFETCH_MAX,
+                    f"bottleneck reader; prefetch {cur['prefetch_depth']}"):
+            return result("raise-prefetch",
+                          "the reader is the measured critical path: "
+                          "double prefetch_depth so the reader runs "
+                          "further ahead of the window",
+                          {"prefetch_depth": cur["prefetch_depth"] * 2})
+        return result("raise-prefetch-at-cap",
+                      f"reader-bound with prefetch_depth "
+                      f"{cur['prefetch_depth']} at/past the {PREFETCH_MAX} "
+                      "cap: the reader itself (disk/decode) is the floor — "
+                      "converged", converged=True)
+
+    # 6. h2d/staging-bound but the window never filled: more inflight buys
+    #    nothing until the feed side keeps it full — raise prefetch first.
+    window_starved = (depth_max is not None
+                     and depth_max < cur["inflight_groups"])
+    if resource in ("h2d", "staging") and window_starved:
+        if consider("feed-window", cur["prefetch_depth"] * 2 <= PREFETCH_MAX,
+                    f"{resource}-bound but depth peaked at {depth_max} < "
+                    f"inflight {cur['inflight_groups']}"):
+            return result("feed-window",
+                          f"{resource}-bound but the window never filled "
+                          f"(depth_max {int(depth_max)} < inflight "
+                          f"{cur['inflight_groups']}): feed it — double "
+                          "prefetch_depth before touching the window",
+                          {"prefetch_depth": cur["prefetch_depth"] * 2})
+        return result("feed-window-at-cap",
+                      f"{resource}-bound, window never filled, prefetch "
+                      f"already at {PREFETCH_MAX}: converged",
+                      converged=True)
+
+    # 7. h2d/staging-bound with a fed window: deepen it so transfers and
+    #    host assembly of MORE groups hide behind device compute.
+    if resource in ("h2d", "staging"):
+        if consider("raise-inflight",
+                    cur["inflight_groups"] * 2 <= INFLIGHT_MAX,
+                    f"bottleneck {resource}; "
+                    f"inflight {cur['inflight_groups']}"):
+            return result("raise-inflight",
+                          f"{resource} is the measured critical path: "
+                          "double inflight_groups so more transfers/"
+                          "staging overlap device compute",
+                          {"inflight_groups": cur["inflight_groups"] * 2})
+        return result("raise-inflight-at-cap",
+                      f"{resource}-bound with inflight_groups "
+                      f"{cur['inflight_groups']} at/past the "
+                      f"{INFLIGHT_MAX} cap: converged", converged=True)
+
+    # 8. Device-bound + window always full: the device is the ceiling and
+    #    the window is doing its job — STOP raising inflight; amortize
+    #    per-dispatch overhead instead (decisive on high-latency links).
+    if resource == "device":
+        always_full = full_frac is not None and full_frac >= ALWAYS_FULL_FRAC
+        if always_full and consider(
+                "try-superstep", cur["superstep"] * 2 <= SUPERSTEP_MAX,
+                f"device-bound, full_frac {full_frac}; "
+                f"superstep {cur['superstep']}"):
+            return result("try-superstep",
+                          "device-bound with the window at capacity on "
+                          f"{full_frac:.0%} of dispatches: a deeper window "
+                          "cannot help — double superstep to amortize "
+                          "per-dispatch overhead instead",
+                          {"superstep": cur["superstep"] * 2})
+        if always_full:
+            return result("try-superstep-at-cap",
+                          f"device-bound, window always full, superstep "
+                          f"{cur['superstep']} at/past the "
+                          f"{SUPERSTEP_MAX} cap: converged", converged=True)
+        return result("device-bound",
+                      "the device is the measured critical path and the "
+                      "window never saturated: compute itself is the "
+                      "ceiling — converged", converged=True)
+
+    # 9. Nothing actionable (retire-bound bookkeeping, unknown resource).
+    return result("no-rule",
+                  f"no move rule matches (resource={resource!r}, "
+                  f"data={verdict!r}): converged", converged=True)
+
+
+# -- the search loop ---------------------------------------------------------
+
+def _key(knobs: dict):
+    return tuple(int(knobs[k]) for k in KNOBS)
+
+
+def search(measure: Callable[[dict], Iterable[dict]],
+           start: Optional[dict] = None, *, budget: int = 6,
+           backend: str = "auto") -> dict:
+    """Walk the rule table: ``measure(knobs)`` runs one probe pass and
+    returns its ledger records; :func:`propose` picks the next config;
+    repeat until a proposal converges, a proposed config was already
+    visited (the **oscillation guard** — two rules pulling a knob in
+    opposite directions terminate instead of ping-ponging), or ``budget``
+    passes are exhausted.  Every accepted config is validated through
+    :func:`validate_knobs` BEFORE it is measured.
+
+    Returns ``{winner, stopped, passes, trail}``: ``winner`` is a config
+    actually MEASURED — a final proposal the budget left no pass to run
+    stays in the trail but never becomes the winner (the recorded
+    winner/GB-s pair must describe a config that was actually observed).
+    ``stopped`` is one of ``converged`` / ``oscillation`` /
+    ``budget-exhausted``; on an oscillation stop the tie is real — both
+    configs' own verdicts voted to move away from them — so the winner
+    is the measured config with the best run_end throughput among the
+    passes (falling back to the last measured config when no pass
+    carried one).  ``trail`` is the full per-pass proposal list — the
+    machine-readable decision trail.
+    """
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1, got {budget}")
+    cur = default_knobs()
+    if start:
+        cur.update({k: int(v) for k, v in start.items() if k in cur})
+    validate_knobs(cur, backend)
+    seen = {_key(cur)}
+    trail: List[dict] = []
+    measured: List[tuple] = []  # (knobs, run_end gb_per_s or None) per pass
+    win_idx = 0
+    stopped = "budget-exhausted"
+    for _ in range(budget):
+        records = list(measure(dict(cur)))
+        prop = propose(records, current=cur)
+        trail.append(prop)
+        measured.append((dict(cur), prop["signals"].get("gb_per_s")))
+        win_idx = len(measured) - 1
+        if prop["converged"]:
+            stopped = "converged"
+            break
+        nxt = {k: prop["proposal"][k] for k in KNOBS}
+        validate_knobs(nxt, backend)
+        if _key(nxt) in seen:
+            prop["oscillation"] = True
+            stopped = "oscillation"
+            # An oscillation is a genuine tie: each side's own verdict
+            # voted to leave it.  Break it with the one signal the rule
+            # table deliberately ignores — measured throughput (later
+            # pass wins a throughput tie).
+            rated = [(g, i) for i, (_, g) in enumerate(measured)
+                     if g is not None]
+            if rated:
+                win_idx = max(rated)[1]
+            break
+        seen.add(_key(nxt))
+        if len(trail) >= budget:
+            # Budget exhausted: the accepted proposal would never be
+            # measured — stop at the measured config instead of advancing.
+            break
+        cur = nxt
+    # winner and winner_gbps come from the SAME pass, so a recorded
+    # config/value pair always describes one observed run (on an
+    # oscillation stop the last pass's throughput belongs to the losing
+    # config — returning it would misprice the winner).
+    winner, winner_gbps = measured[win_idx]
+    return {"tuner_version": TUNER_VERSION, "winner": winner,
+            "winner_gbps": winner_gbps, "stopped": stopped,
+            "passes": len(trail), "trail": trail}

@@ -324,10 +324,17 @@ def analyze_run(records: list) -> dict:
         dh = _datahealth_mod()
         if dh is not None:
             data_health = dh.classify(data)
+    # Autotune recommendation (ISSUE 10): the `tune` record of hint-mode
+    # runs, passed through as-is (future shapes render defensively).
+    tune = next((r for r in records if r.get("kind") == "tune"), None)
+    if tune is not None:
+        tune = {k: v for k, v in tune.items()
+                if k not in ("ts", "run_id", "kind")}
     return {
         "timeline": timeline,
         "data": data,
         "data_health": data_health,
+        "tune": tune,
         "pipeline": pipeline,
         "overlap_fraction": (pipeline or {}).get("overlap_fraction"),
         "pipeline_flags": pipeline_flags(phases, pipeline),
@@ -446,6 +453,17 @@ def render_run(a: dict, out) -> None:
         out.write(f"  data health: {health['verdict']}\n")
         for f in health.get("flags", []):
             out.write(f"  DATA {f['flag']}: {f['detail']}\n")
+    t = a.get("tune")
+    if t:
+        changed = t.get("changed") or {}
+        moves = ", ".join(
+            f"{k} {v[0]} -> {v[1]}"
+            if isinstance(v, (list, tuple)) and len(v) == 2
+            else f"{k}: {v}" for k, v in changed.items())
+        verdict = "converged" if t.get("converged") else (moves or "no move")
+        out.write(f"  tune: {t.get('rule', '?')} — {verdict}\n")
+        if t.get("reason"):
+            out.write(f"    {t['reason']}\n")
     for f in a.get("pipeline_flags", []):
         out.write(f"  PIPELINE {f['flag']}: {f['detail']}\n")
     for f in a.get("map_flags", []):
@@ -597,7 +615,7 @@ def selftest() -> int:
     ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 5, f"fixture holds five runs, got {len(runs)}"
+    assert len(runs) == 6, f"fixture holds six runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -659,15 +677,32 @@ def selftest() -> int:
     # The phase classifier agrees with the measured timeline here (both
     # say the reader) — the timeline adds the HOW MUCH the deltas cannot.
     assert d["classification"] == "read-bound", d["classification"]
-    # Run 5 (ISSUE 8): a spill-heavy pallas run carrying per-group `data`
-    # dicts and the per-run `data` record.  Checked against the arithmetic
-    # done by hand on the fixture: 3 of 6 chunks took the full-resolution
-    # fallback (fallback_frac 0.5 > the 5% gate), overlong is 120/60000 =
-    # 0.2% of the stream with one tier-2 escalation, the top key carries
-    # 1500/60000 = 2.5% (NOT skew-hot at the 5% gate), and 20 distinct
-    # keys spilled — so the verdict is spill-bound with rescue-heavy and
-    # table-pressure riding along, and nothing else.
-    e = runs[4]
+    # Run 5 in file order (ISSUE 10): a ledger-v4 autotune-hint run.  The
+    # `tune` record (recommendation + decision trail) must surface next to
+    # the verdicts it was derived from — here a reader-bound run whose
+    # hint doubles prefetch_depth — and the other runs (no tune record)
+    # must carry None.  (It sits BEFORE fixture05 in the file so the
+    # spill-heavy run stays the --compare pick below.)
+    g7 = runs[4]
+    assert g7["header"]["ledger_version"] == 4, g7["header"]
+    tn = g7["tune"]
+    assert tn is not None and tn["rule"] == "raise-prefetch", tn
+    assert tn["changed"] == {"prefetch_depth": [4, 8]}, tn["changed"]
+    assert tn["converged"] is False and tn["mode"] == "hint", tn
+    assert tn["signals"]["resource"] == "reader", tn["signals"]
+    assert tn["trail"], "decision trail must ride the record"
+    assert g7["timeline"]["bottleneck"]["resource"] == "reader", \
+        "the tune hint and the timeline verdict describe the same run"
+    # Run 6 in file order (ISSUE 8): a spill-heavy pallas run carrying
+    # per-group `data` dicts and the per-run `data` record.  Checked
+    # against the arithmetic done by hand on the fixture: 3 of 6 chunks
+    # took the full-resolution fallback (fallback_frac 0.5 > the 5%
+    # gate), overlong is 120/60000 = 0.2% of the stream with one tier-2
+    # escalation, the top key carries 1500/60000 = 2.5% (NOT skew-hot at
+    # the 5% gate), and 20 distinct keys spilled — so the verdict is
+    # spill-bound with rescue-heavy and table-pressure riding along, and
+    # nothing else.
+    e = runs[5]
     assert e["header"]["ledger_version"] == 3, e["header"]
     assert e["data"] is not None and e["data"]["fallback_chunks"] == 3
     eh = e["data_health"]
@@ -685,6 +720,8 @@ def selftest() -> int:
     egroups = [r for r in read_ledger(ledger)
                if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
     assert all("data" in g for g in egroups), egroups
+    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5)), \
+        "runs without a tune record must carry None"
     # The clean A/B counterpart (mini_ledger_b): uniform corpus, no
     # fallbacks, top key at 24/60000 = 0.04% — verdict clean; the pair is
     # the checked-in proof that a hot-key corpus and a uniform one are
@@ -704,6 +741,7 @@ def selftest() -> int:
     render_run(c, buf)
     render_run(d, buf)
     render_run(e, buf)
+    render_run(g7, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert "ANOMALY step-time spike" in body
@@ -720,6 +758,7 @@ def selftest() -> int:
     assert "data health: spill-bound" in body
     assert "DATA spill-bound" in body and "DATA rescue-heavy" in body
     assert "spill fallbacks 3" in body
+    assert "tune: raise-prefetch — prefetch_depth 4 -> 8" in body
     # A/B ledger diffing (ISSUE 8 satellite): the spill-heavy run vs the
     # clean uniform counterpart must render one table naming both data
     # verdicts, and the machine-readable form must carry the rows.
@@ -750,6 +789,10 @@ def selftest() -> int:
     assert f["data"] is not None and f["data_health"] is not None, \
         "the future data record must classify (extra fields ignored)"
     assert f["data_health"]["verdict"] == "skew-hot", f["data_health"]
+    # The future-shaped `tune` record (unknown rule, non-knob changes, an
+    # opaque trail) must pass through and render without error (ISSUE 10
+    # forward compat).
+    assert f["tune"] is not None and f["tune"]["rule"] == "warp-rebalance"
     render_run(f, io.StringIO())
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
@@ -757,7 +800,8 @@ def selftest() -> int:
           f"{len(a['pipeline_flags']) + len(b['pipeline_flags'])} "
           f"pipeline flags, {len(c['map_flags'])} map flag, "
           f"timeline bottleneck={bn['resource']}, "
-          f"data health={eh['verdict']}, compare ok, future-ledger ok)")
+          f"data health={eh['verdict']}, tune rule={tn['rule']}, "
+          "compare ok, future-ledger ok)")
     return 0
 
 

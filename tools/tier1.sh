@@ -27,4 +27,8 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-m
 # A/B diff are certified before a single test runs, in seconds.
 timeout -k 5 60 python tools/obs_report.py --selftest || { echo "TIER1: obs_report selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_export selftest FAILED"; exit 1; }
+# Autotuner gate (ISSUE 10): the rule-table/search/oscillation-guard walk
+# over the checked-in tuner fixtures, hand-computed targets asserted —
+# also jax-free, seconds.
+timeout -k 5 60 python tools/autotune.py --selftest || { echo "TIER1: autotune selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
