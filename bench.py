@@ -31,11 +31,14 @@ step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
 (device passes over the resident corpus in the timed dispatch, default 8),
 BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
-BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_MERGE_EVERY / BENCH_COMPACT_SLOTS /
+BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_COMBINER / BENCH_MERGE_EVERY /
+BENCH_COMPACT_SLOTS /
 BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
 so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
 dispatch-window control, see Config.inflight_groups; BENCH_MAP_IMPL=fused
-runs the ISSUE 6 fused map kernel, see Config.map_impl).
+runs the ISSUE 6 fused map kernel, see Config.map_impl;
+BENCH_COMBINER=hot-cache runs the ISSUE 11 map-side combiner on top of
+it, see Config.combiner).
 
 BENCH JSON carries a `cost` record: the static hbm-cost pricing
 (`effective_input_passes`) of the benched map path's registry twin
@@ -547,6 +550,9 @@ def main() -> int:
     # BENCH_SORT_IMPL A/Bs the Pallas radix partition/sort against the XLA
     # sort floor (BENCHMARKS.md round-6 pricing note; bit-identical
     # results) — a measurement-altering knob, so LAST_GOOD refuses it.
+    # BENCH_COMBINER A/Bs the ISSUE 11 map-side combiner (hot-cache /
+    # salt; pairs with BENCH_MAP_IMPL=fused) — measurement-altering, so
+    # LAST_GOOD's class-based knob gate refuses it like every other A/B.
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("BENCH_SORT_MODE",
@@ -555,6 +561,8 @@ def main() -> int:
                                           Config.sort_impl),
                  map_impl=os.environ.get("BENCH_MAP_IMPL",
                                          Config.map_impl),
+                 combiner=os.environ.get("BENCH_COMBINER",
+                                         Config.combiner),
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ
@@ -745,7 +753,8 @@ def main() -> int:
     # passes next to the measured GB/s, so the fused-vs-split A/B rows
     # carry the prediction and the measurement in one JSON.
     result["map_impl"] = cfg.map_impl
-    cost = _cost_record(cfg.map_impl)
+    result["combiner"] = cfg.resolved_combiner
+    cost = _cost_record(cfg.map_impl, cfg.resolved_combiner)
     if cost is not None:
         result["cost"] = cost
     if streamed_gbps is not None:
@@ -860,19 +869,25 @@ def _time_ratio(ratio: float | None) -> float | None:
     return round(1.0 / ratio, 4)
 
 
-def _cost_record(map_impl: str) -> dict | None:
-    """Static hbm-cost pricing of the benched map path (ISSUE 6): run the
-    analysis cost pass over the registry twin of the benched config
-    (wordcount_fused when BENCH_MAP_IMPL=fused, else wordcount_pallas) and
-    surface `effective_input_passes` — plus the fused-vs-split gap the
-    pass certifies — in BENCH JSON.  Pure tracing, no device work; any
-    failure is logged and skipped (the measured row must survive)."""
+def _cost_record(map_impl: str, combiner: str = "off") -> dict | None:
+    """Static hbm-cost pricing of the benched map path (ISSUE 6/11): run
+    the analysis cost pass over the registry twin of the benched config
+    (wordcount_combiner when the hot-key combiner is on, wordcount_fused
+    when BENCH_MAP_IMPL=fused, else wordcount_pallas) and surface
+    `effective_input_passes` — plus the fused-vs-split / combiner-vs-off
+    gap the pass certifies — in BENCH JSON.  Pure tracing, no device
+    work; any failure is logged and skipped (the measured row must
+    survive)."""
     try:
         from mapreduce_tpu import analysis, models
         from mapreduce_tpu.analysis.passes.cost import CostPass
 
-        name = ("wordcount_fused" if map_impl == "fused"
-                else "wordcount_pallas")
+        if combiner == "hot-cache" and map_impl == "fused":
+            name = "wordcount_combiner"
+        elif map_impl == "fused":
+            name = "wordcount_fused"
+        else:
+            name = "wordcount_pallas"
         rep = analysis.analyze_job(models.build_model(name), name,
                                    passes=[CostPass()])
         art = rep.artifacts.get(name, {}).get("cost")
@@ -882,6 +897,8 @@ def _cost_record(map_impl: str) -> dict | None:
                "effective_input_passes": art.get("effective_input_passes")}
         if "fused_vs_split" in art:
             rec["fused_vs_split"] = art["fused_vs_split"]
+        if "combiner_vs_off" in art:
+            rec["combiner_vs_off"] = art["combiner_vs_off"]
         return rec
     except Exception as e:  # noqa: BLE001 — advisory, never fatal
         print(f"[bench] cost artifact skipped ({e!r})", file=sys.stderr)

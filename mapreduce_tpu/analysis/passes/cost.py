@@ -51,6 +51,18 @@ REGRESSION_TOLERANCE = 0.20
 _SPLIT_COUNTERPART = {"wordcount_fused": "wordcount_pallas",
                       "wordcount_fused_telemetry": "wordcount_telemetry"}
 
+# Combiner registry models gated against their combiner-OFF twin's baseline
+# (same chunk geometry, Config.combiner the only delta — ISSUE 11): the
+# hot-key cache must price STRICTLY below the uncombined fused path, the
+# machine-checked proof that the taller windows it pays for actually
+# delete sort traffic.  Models in this dict (and their counterparts) are
+# exempt from the fused-vs-split gate: their fused-ness is already
+# certified by wordcount_fused at ITS geometry, and this pair exists at a
+# different chunk so the combiner's window arithmetic is exact.
+_UNCOMBINED_COUNTERPART = {"wordcount_combiner": "wordcount_nocombiner"}
+_FUSED_GATE_EXEMPT = set(_UNCOMBINED_COUNTERPART) \
+    | set(_UNCOMBINED_COUNTERPART.values())
+
 # Data-stats-instrumented registry models gated against their
 # UNINSTRUMENTED twin's baseline (same config, Engine data_stats the only
 # delta — ISSUE 8): observability must never silently regress the cost
@@ -120,6 +132,7 @@ class CostPass:
         out.extend(self._sort_findings(ctx, report))
         out.extend(self._baseline_findings(ctx, report))
         out.extend(self._fused_gate_findings(ctx, report))
+        out.extend(self._combiner_gate_findings(ctx, report))
         out.extend(self._telemetry_gate_findings(ctx, report))
         ctx.artifacts["cost"] = report
         return out
@@ -196,9 +209,15 @@ class CostPass:
                 isinstance(step, trace.TraceFailure):
             return []
         # The measured claim is about the shipped packed fast path: pallas
-        # backend, stable2 comparator, XLA sort implementation.
+        # backend, stable2 comparator, XLA sort implementation, at the
+        # DEFAULT 384-row window.  The combiner's 512-row geometry sorts a
+        # deliberately different row count — extrapolating the measured
+        # 384-geometry sort milliseconds over it would manufacture a
+        # phantom pricing drift; its own strictly-below gate
+        # (_combiner_gate_findings) owns that geometry instead.
         if config.resolved_backend() != "pallas" or \
-                config.sort_mode != "stable2" or config.sort_impl != "xla":
+                config.sort_mode != "stable2" or config.sort_impl != "xla" \
+                or config.resolved_combiner_slots:
             return []
         sort = costmodel.find_aggregation_sort(step, num_keys=2)
         if sort is None:
@@ -274,7 +293,8 @@ class CostPass:
         config = getattr(ctx.job, "config", None)
         passes = report.get("effective_input_passes")
         if config is None or passes is None or config.map_impl != "fused" \
-                or config.resolved_backend() != "pallas":
+                or config.resolved_backend() != "pallas" \
+                or ctx.model in _FUSED_GATE_EXEMPT:
             return []
         split_model = _SPLIT_COUNTERPART.get(ctx.model)
         if split_model is None:
@@ -352,6 +372,85 @@ class CostPass:
                      f"vs split baseline {split_ref:.2f} ({split_model}) — "
                      f"{split_ref - passes:.2f} passes of token-plane "
                      "round-trip deleted"))]
+
+    # -- combiner-vs-off gate (ISSUE 11) --------------------------------
+
+    def _combiner_gate_findings(self, ctx, report) -> list[core.Finding]:
+        """A hot-key-combiner model must price STRICTLY below its
+        combiner-off twin's checked-in baseline at the same chunk
+        geometry — the fused-vs-split discipline applied to the taller
+        combiner windows: the cache only exists to delete sort rows, so
+        the moment it stops doing that statically, CI says so."""
+        config = getattr(ctx.job, "config", None)
+        passes = report.get("effective_input_passes")
+        off_model = _UNCOMBINED_COUNTERPART.get(ctx.model)
+        if config is None or passes is None or off_model is None:
+            return []
+        if not config.resolved_combiner_slots:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message="combiner-gated model resolves to NO hot-key cache "
+                        "(combiner/map_impl/compact config drifted): the "
+                        "gate would compare two identical programs",
+                hint="keep COMBINER_ANALYSIS_CONFIG on the fused compact "
+                     "path with combiner='hot-cache'")]
+        off = load_baseline(off_model, ctx.baselines_dir)
+        if off is None:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"combiner-off counterpart {off_model!r} has no "
+                         "cost baseline: the combiner's win is unmeasured"),
+                hint=f"regenerate with `python -m mapreduce_tpu.analysis "
+                     f"{off_model} --write-baselines` and commit the JSON")]
+        off_raw = off.get("effective_input_passes")
+        if not isinstance(off_raw, (int, float)) or off_raw <= 0:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"counterpart {off_model!r} baseline has no usable "
+                         f"effective_input_passes ({off_raw!r}): the "
+                         "combiner gap cannot be gated"),
+                hint=f"regenerate with `python -m mapreduce_tpu.analysis "
+                     f"{off_model} --write-baselines` and commit the JSON")]
+        if off.get("traced_chunk_bytes") != report["traced_chunk_bytes"]:
+            # Same no-publish rule as the fused gate: an incomparable gap
+            # must never reach BENCH JSON via the copied artifact.
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"combiner model traces a "
+                         f"{report['traced_chunk_bytes']}-byte chunk but "
+                         f"{off_model!r} priced "
+                         f"{off.get('traced_chunk_bytes')!r}: the passes "
+                         "are not comparable"),
+                hint="keep COMBINER_ANALYSIS_CONFIG and its twin on the "
+                     "same chunk geometry")]
+        off_ref = float(off_raw)
+        report["combiner_vs_off"] = {
+            "off_model": off_model,
+            "off_effective_input_passes": off_ref,
+            "combiner_effective_input_passes": passes,
+            "passes_saved": round(off_ref - passes, 3)}
+        if passes >= off_ref:
+            return [core.Finding(
+                severity=core.ERROR, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"hot-key combiner prices {passes:.2f} effective "
+                         f"HBM passes, NOT strictly below the combiner-off "
+                         f"baseline {off_ref:.2f} ({off_model}): the cache "
+                         "stopped deleting sort traffic"),
+                hint="the taller-window arithmetic broke (geometry drift?) "
+                     "or the off baseline is stale; fix or re-measure "
+                     "deliberately, BENCHMARKS.md discipline")]
+        return [core.Finding(
+            severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+            hook="step",
+            message=(f"combiner certified: {passes:.2f} effective HBM "
+                     f"passes vs combiner-off baseline {off_ref:.2f} "
+                     f"({off_model}) — {off_ref - passes:.2f} passes of "
+                     "sort traffic deleted"))]
 
     # -- baseline regression gate ---------------------------------------
 

@@ -170,6 +170,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "aggregation sort (costcheck prices the gap; "
                         "'split' stays default until the on-chip window "
                         "confirms the predicted win, BENCHMARKS.md round 9)")
+    p.add_argument("--combiner", choices=("off", "hot-cache", "salt", "auto"),
+                   default="off",
+                   help="skew-adaptive map-side combiner (bit-identical "
+                        "results): 'hot-cache' = a per-lane VMEM hot-key "
+                        "cache in the fused pallas kernel pre-aggregates "
+                        "the top-mass keys per chunk, deleting the "
+                        "dominant duplicate rows before the aggregation "
+                        "sort (pairs with --map-impl fused; taller kernel "
+                        "windows cut sort rows ~25%%, priced by costcheck); "
+                        "'salt' = spread a pathological single hot key "
+                        "over salted sort segments with an exact de-salt "
+                        "at the reduce; 'auto' = resolve from the previous "
+                        "run's data-health verdict in --ledger (skew-hot "
+                        "-> hot-cache, else off)")
+    p.add_argument("--combiner-slots", type=int, default=None, metavar="C",
+                   help="per-lane hot-key cache entries for --combiner "
+                        "hot-cache (multiple of 8 in [8, 32]; default 8)")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -523,12 +540,40 @@ def main(argv: list[str] | None = None) -> int:
                         map_impl=args.map_impl,
                         merge_every=args.merge_every,
                         compact_slots=args.compact_slots,
+                        combiner=args.combiner,
+                        combiner_slots=args.combiner_slots,
                         rescue_overlong=args.rescue_overlong,
                         rescue_overlong_max=args.rescue_overlong_max,
                         rescue_window=args.rescue_window,
                         autotune="hint" if args.autotune else "off")
     except ValueError as e:
         parser.error(str(e))
+
+    if args.combiner == "auto":
+        # Resolve 'auto' BEFORE any trace, against the prior run's records
+        # in the --ledger file (append-mode ledgers hold run history — the
+        # most recent data-health verdict decides; no ledger history
+        # resolves to 'off').  The resolved mode is stamped into this
+        # run's own run_start/data records, so a chain of 'auto' runs is
+        # a self-documenting feedback loop.
+        import dataclasses as _dc
+
+        records = []
+        if args.ledger and os.path.exists(args.ledger):
+            from mapreduce_tpu.obs import read_ledger
+
+            records = read_ledger(args.ledger)
+        from mapreduce_tpu.obs import datahealth
+
+        resolved = datahealth.resolve_combiner(records)
+        # An 'off' resolution also drops any explicit cache sizing: the
+        # slots knob only exists with the cache (Config validates that).
+        config = _dc.replace(
+            config, combiner=resolved,
+            combiner_slots=config.combiner_slots
+            if resolved == "hot-cache" else None)
+        print(f"combiner: auto -> {resolved}"
+              + ("" if records else " (no ledger history)"), file=sys.stderr)
 
     from mapreduce_tpu.runtime import profiling
 
@@ -660,6 +705,7 @@ def _batch_run_start(tel, job: str, paths, config, input_bytes: int) -> None:
                      devices=1, chunk_bytes=input_bytes,
                      superstep=1, backend=_resolved_backend_name(config),
                      map_impl=config.map_impl,
+                     combiner=config.resolved_combiner,
                      merge_strategy="none", input=list(paths),
                      resume_step=0, resume_offset=0, retry=0)
 
@@ -707,6 +753,7 @@ def _wordcount_main(args, paths, data, config, input_bytes: int,
             "data", groups=1, chunks=1,
             backend=_resolved_backend_name(config),
             map_impl=config.map_impl,
+            combiner=config.resolved_combiner,
             capacity=config.table_capacity, tokens=result.total,
             dropped_tokens=result.dropped_count,
             dropped_uniques=result.dropped_uniques,

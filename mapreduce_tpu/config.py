@@ -27,6 +27,13 @@ SEGMIN_TPU_ERROR = (
     "MAPREDUCE_ALLOW_SEGMIN=1 to re-measure deliberately.")
 
 
+#: Salt width for combiner='salt': one hot key spreads over 2**3 = 8 sort
+#: segments — enough to defeat the measured ~4x radix hot-key slab
+#: amplification while keeping the de-salt coalesce's collision envelope
+#: a single-digit multiple of the documented 64-bit key envelope.
+COMBINER_SALT_BITS = 3
+
+
 def segmin_allowed() -> bool:
     """Single owner of the MAPREDUCE_ALLOW_SEGMIN override parse: the raw
     string truthiness trap ('0' would bypass the wedge guard) is avoided by
@@ -243,6 +250,54 @@ class Config:
     # offline).  Hints are a host-local-driver feature like retry and
     # data stats: run_job_global ignores the knob.
     autotune: str = "off"
+    # Skew-adaptive map-side combiner (ISSUE 11, ROADMAP item 5): what to
+    # do about Zipf-hot keys BEFORE the aggregation sort sees them.
+    # 'off' (default): the shipped behavior.  'hot-cache': the fused
+    # compact kernel threads a small VMEM-resident hot-key cache through
+    # the tile grid (the seam-carry idiom) — per lane, the first
+    # ``combiner_slots`` distinct keys are cached, every further
+    # occurrence of a cached key is counted IN VMEM and emits nothing,
+    # and at chunk end the cache flushes one exact (key, count,
+    # first-occurrence) row per resident entry into a tiny table merged
+    # with the chunk's batch table.  On Zipf streams the dominant
+    # duplicate runs collapse before the stable2 sort materializes them,
+    # which pays for a taller kernel window (block_rows 384 -> 512 at the
+    # same 128 slots: ~25% fewer sort rows per chunk at the production
+    # geometry, priced and ERROR-gated by the costcheck combiner gate);
+    # denser-than-budget windows keep the exact spill fallback, so
+    # results stay bit-identical to 'off' on EVERY distribution.  Applies
+    # to the fused pallas compact path (map_impl='fused'); elsewhere it
+    # is a documented no-op, like compact_slots on the xla backend.
+    # 'salt': key-salting for pathological single-key streams — the
+    # packed table build XORs low position bits into key_lo so one
+    # scorching key spreads over 2**COMBINER_SALT_BITS sort segments
+    # (radix slab amplification on hot keys measured ~4x, BENCHMARKS.md
+    # round 6), then de-salts and re-reduces the capacity-sized table
+    # exactly at the reduce seam.  Envelope (ops/table.from_packed_rows
+    # documents both legs): exact de-salting widens the documented
+    # ~n^2/2^65 64-bit key-collision envelope by the salt factor (8x at
+    # the default 3 bits; --verify-sample detects as ever — the
+    # single-key streams salting exists for cannot collide at all), and
+    # bit-identity to 'off' holds while distinct keys fit the batch
+    # capacity (under unique overflow the cutoff falls on salted key
+    # order; occurrence totals stay conserved via dropped accounting).
+    # Applies to the packed fast path (pallas wordcount family + gram
+    # builds on both backends), the sort_mode/sort_impl scope.
+    # 'auto': resolve from the PREVIOUS run's data-health verdict — the
+    # first config knob chosen by the data, not the operator: skew-hot ->
+    # 'hot-cache', anything else (or no ledger history) -> 'off'.  The
+    # CLI resolves it against --ledger's existing records before any
+    # trace (obs/datahealth.resolve_combiner); an unresolved 'auto'
+    # (library callers that never resolve) behaves as 'off'.  The
+    # autotuner's `skew-hot -> enable-combiner` rule proposes the same
+    # flip from measured ledgers (mapreduce_tpu/tuning/).
+    combiner: str = "off"
+    # Per-lane hot-key cache entries for combiner='hot-cache' (multiple
+    # of 8 for sublane tiling, in [8, 32]).  None resolves to 8: the
+    # cache planes stay one (8, 128) tile each, and on Zipf the top
+    # handful of keys carries the collapsible mass (PR 8's top_mass
+    # proxy measures exactly this).
+    combiner_slots: Optional[int] = None
     # Second-tier rescue budget (VERDICT r4 weak #4): URL-heavy text carries
     # ~15K overlong occurrences per 32 MB chunk (tools/overlong.py) — far
     # past the 1024-slot primary budget, which silently left >90% of them
@@ -323,6 +378,28 @@ class Config:
             if self.rescue_window > 4096:
                 raise ValueError(
                     f"rescue_window must be <= 4096, got {self.rescue_window}")
+        if self.combiner not in ("off", "hot-cache", "salt", "auto"):
+            raise ValueError(f"unknown combiner {self.combiner!r} (expected "
+                             "'off', 'hot-cache', 'salt' or 'auto')")
+        if self.combiner == "salt" and self.sort_mode == "segmin":
+            # Fail at construction, not minutes into a trace: segmin keeps
+            # packed as an unordered payload, so the de-salt has no
+            # per-segment position order to recover the XOR from.
+            raise ValueError(
+                "combiner='salt' requires sort_mode='sort3' or 'stable2' "
+                "(the de-salt reads each kept row's own position; segmin "
+                "keeps packed as an unordered payload)")
+        if self.combiner_slots is not None:
+            # Mirrors the kernel wrapper's envelope (fail at construction,
+            # not mid-trace): one or more whole (8, 128) cache tiles.
+            if self.combiner_slots % 8 or not 8 <= self.combiner_slots <= 32:
+                raise ValueError(
+                    f"combiner_slots must be a multiple of 8 in [8, 32], "
+                    f"got {self.combiner_slots}")
+            if self.combiner not in ("hot-cache", "auto"):
+                raise ValueError(
+                    "combiner_slots sizes the hot-key cache; set "
+                    "combiner='hot-cache' (or 'auto') to use it")
         if self.autotune not in ("off", "hint"):
             raise ValueError(f"unknown autotune mode {self.autotune!r} "
                              "(expected 'off' or 'hint')")
@@ -384,11 +461,41 @@ class Config:
         return 128 if self.sort_mode == "stable2" else 88
 
     @property
+    def resolved_combiner(self) -> str:
+        """The combiner mode the trace actually runs (see ``combiner``):
+        an unresolved 'auto' behaves as 'off' — resolution against a
+        prior ledger is the driver's job (CLI / tools), never the
+        trace's."""
+        return "off" if self.combiner == "auto" else self.combiner
+
+    @property
+    def resolved_combiner_slots(self) -> int:
+        """Per-lane hot-key cache entries (0 = no cache).  Nonzero only
+        where the cache exists: the fused pallas compact path under
+        combiner='hot-cache'."""
+        if self.resolved_combiner != "hot-cache" or self.map_impl != "fused" \
+                or not self.resolved_compact_slots:
+            return 0
+        return self.combiner_slots if self.combiner_slots is not None else 8
+
+    @property
+    def resolved_salt_bits(self) -> int:
+        """Low position bits XORed into key_lo by the packed table build
+        under combiner='salt' (0 = no salting)."""
+        return COMBINER_SALT_BITS if self.resolved_combiner == "salt" else 0
+
+    @property
     def resolved_block_rows(self) -> int | None:
         """Kernel window height in byte rows: 384 under stable2 (so the
-        transposed output block is a tile-aligned (128, 128) store), else
-        the kernel's own default (None -> 256)."""
-        return 384 if self.sort_mode == "stable2" else None
+        transposed output block is a tile-aligned (128, 128) store), 512
+        when the hot-key combiner runs (the cache absorbs the dominant
+        duplicates, so taller windows — ~25% fewer sort rows per chunk —
+        stay within the same 128-slot budget; denser windows keep the
+        exact spill fallback), else the kernel's own default (None ->
+        256)."""
+        if self.sort_mode != "stable2":
+            return None
+        return 512 if self.resolved_combiner_slots else 384
 
     @property
     def resolved_prefetch_depth(self) -> int:

@@ -52,6 +52,22 @@ PALLAS_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 384, table_capacity=512,
 FUSED_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 384, table_capacity=512,
                                backend="pallas", map_impl="fused")
 
+# Skew-adaptive map-side combiner pair (ISSUE 11): the Zipf-shaped model
+# with the hot-key cache ON vs its combiner-off twin, both fused/stable2
+# at one shared chunk geometry so the hbm-cost combiner gate compares
+# like with like.  The chunk is 128 * 512 — the analyzer's 64 KiB
+# tracing cap, and one lane segment spanning a whole combiner window —
+# so the sort-row delta is exact window arithmetic: nocombiner grids 3
+# 384-row windows of 128 slots (49152 sort rows), the combiner 2
+# 512-row windows (32768 rows, −33%; −25% at the 32 MB production chunk
+# where the padding window amortizes away).
+COMBINER_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 512, table_capacity=512,
+                                  backend="pallas", map_impl="fused",
+                                  combiner="hot-cache")
+NOCOMBINER_ANALYSIS_CONFIG = Config(chunk_bytes=128 * 512,
+                                    table_capacity=512,
+                                    backend="pallas", map_impl="fused")
+
 
 def _wordcount(config: Config):
     from mapreduce_tpu.models.wordcount import WordCountJob
@@ -114,6 +130,26 @@ def _wordcount_fused(config: Config):
     return WordCountJob(FUSED_ANALYSIS_CONFIG)
 
 
+def _wordcount_combiner(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config (see _wordcount_radix): the Zipf-shaped combiner-ON
+    # program — the hbm-cost combiner gate prices it strictly below its
+    # combiner-off twin, and the vmem/kernelrace passes certify the
+    # hot-key cache's revisited-output discipline.
+    del config
+    return WordCountJob(COMBINER_ANALYSIS_CONFIG)
+
+
+def _wordcount_nocombiner(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config: the combiner-off twin at the SAME chunk geometry —
+    # the baseline the combiner gate compares against.
+    del config
+    return WordCountJob(NOCOMBINER_ANALYSIS_CONFIG)
+
+
 def _instrumented(job):
     """Mark a job so ``analysis.trace.trace_engine`` builds the Engine in
     data-stats mode (ISSUE 8): the traced step program is the INSTRUMENTED
@@ -152,6 +188,8 @@ _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount_radix": _wordcount_radix,
     "wordcount_pallas": _wordcount_pallas,
     "wordcount_fused": _wordcount_fused,
+    "wordcount_combiner": _wordcount_combiner,
+    "wordcount_nocombiner": _wordcount_nocombiner,
     "wordcount_telemetry": _wordcount_telemetry,
     "wordcount_fused_telemetry": _wordcount_fused_telemetry,
 }
@@ -171,6 +209,7 @@ def build_model(name: str, config: Config = ANALYSIS_CONFIG):
     return factory(config)
 
 
-__all__ = ["ANALYSIS_CONFIG", "FUSED_ANALYSIS_CONFIG",
+__all__ = ["ANALYSIS_CONFIG", "COMBINER_ANALYSIS_CONFIG",
+           "FUSED_ANALYSIS_CONFIG", "NOCOMBINER_ANALYSIS_CONFIG",
            "PALLAS_ANALYSIS_CONFIG", "RADIX_ANALYSIS_CONFIG",
            "build_model", "model_names"]

@@ -402,6 +402,33 @@ class GrepJob(MapReduceJob):
         path's semantics identical)."""
         return state._replace(line_carry=jnp.zeros_like(state.line_carry))
 
+    # -- data-plane telemetry (ISSUE 11 satellite: grep previously forced
+    # -- telemetered runs into plain mode, leaving the classifier — and the
+    # -- combiner's 'auto' switch — blind to this family) -----------------
+
+    def map_chunk_stats_sharded(self, chunk, chunk_id, axis, device_index):
+        """Stats-mode map: grep has no kernel window, rescue tier, or
+        count table, so the chunk counters are structurally zero — the
+        value is the chunks-mapped accounting plus the running gauges
+        ``state_stats`` fills, which complete the data record every
+        shipped family now emits."""
+        from mapreduce_tpu.ops import datastats
+
+        return self.map_chunk_sharded(chunk, chunk_id, axis, device_index), \
+            datastats.map_stats()
+
+    def state_stats(self, state: GrepState, stats):
+        """Fill the running gauges: grep's data volume is its match count
+        (the ``tokens`` lane of the data record — the classifier's ratios
+        all divide by it, and zero matches degrade every signal to None,
+        never to an error)."""
+        from mapreduce_tpu.ops.table import sum64
+
+        m_lo, m_hi = state.matches_lo, state.matches_hi
+        if getattr(m_lo, "ndim", 0):  # MultiGrep: [P] leaves fold to totals
+            m_lo, m_hi = sum64(m_lo, m_hi)
+        return stats._replace(total_lo=m_lo, total_hi=m_hi)
+
     def analysis_observables(self, state: GrepState):
         """graphcheck metadata: the result-bearing leaves the randomized
         merge property check compares.  ``line_carry`` is a coordination

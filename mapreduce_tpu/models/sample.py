@@ -150,6 +150,29 @@ class ReservoirSampleJob:
             length=jnp.where(live, packed & jnp.uint32(63), jnp.uint32(0)),
             total_lo=stream.total, total_hi=jnp.zeros((), jnp.uint32))
 
+    # -- data-plane telemetry (ISSUE 11 satellite: sample previously ran
+    # -- telemetered streams in plain mode — the classifier and the
+    # -- combiner 'auto' switch now cover every shipped family) -----------
+
+    def map_chunk_stats_sharded(self, chunk, chunk_id, axis, device_index):
+        """Stats-mode map: the reservoir has no spill/rescue machinery —
+        counters are structurally zero; the gauges carry the population
+        and reservoir fill."""
+        from mapreduce_tpu.ops import datastats
+
+        del axis, device_index  # the bottom-k map is axis-free
+        return self.map_chunk(chunk, chunk_id), datastats.map_stats()
+
+    def state_stats(self, state: ReservoirState, stats):
+        """Gauges: population size as the ``tokens`` lane, live reservoir
+        slots as ``table_valid`` (a full reservoir at k slots reads as
+        occupancy k/table_capacity — honest, if dimensionless: the
+        reservoir IS this family's table)."""
+        live = jnp.sum((state.prio_hi != _MAXU).astype(jnp.uint32))
+        return stats._replace(table_valid=live,
+                              total_lo=state.total_lo,
+                              total_hi=state.total_hi)
+
     def combine(self, state: ReservoirState, update: ReservoirState) -> ReservoirState:
         cat = lambda f: jnp.concatenate(f)
         parts = _bottom_k(

@@ -98,6 +98,31 @@ def _seam_table_cap(w: int) -> int:
 _SEAM_RESCUE_SLOTS = 384
 
 
+def _combiner_table(cache, pos_hi) -> table_ops.CountTable:
+    """One chunk's flushed hot-key cache -> an exact tiny CountTable
+    (ISSUE 11).  Cache rows carry per-entry counts and the entry's first
+    in-lane occurrence; the same hot key resident in several lanes
+    coalesces through the generic build's segment reduce (counts sum, the
+    smallest position wins), so the merge with the thinned stream's table
+    reproduces the uncombined build bit-for-bit.  Capacity = the plane
+    size: distinct cached keys can never exceed the slot count, so this
+    build is spill-free by construction."""
+    khi = cache.key_hi.reshape(-1)
+    klo = cache.key_lo.reshape(-1)
+    cnt = cache.count.reshape(-1)
+    packed = cache.packed.reshape(-1)
+    live = cnt > 0
+    sent = jnp.uint32(constants.SENTINEL_KEY)
+    inf = jnp.uint32(constants.POS_INF)
+    stream = tok_ops.TokenStream(
+        key_hi=jnp.where(live, khi, sent),
+        key_lo=jnp.where(live, klo, sent),
+        count=jnp.where(live, cnt, jnp.uint32(0)),
+        pos=jnp.where(live, packed >> 6, inf),
+        length=jnp.where(live, packed & jnp.uint32(63), jnp.uint32(0)))
+    return table_ops.from_stream(stream, khi.shape[0], pos_hi=pos_hi)
+
+
 class SeamedUpdate(NamedTuple):
     """A per-chunk map result whose seam table has NOT been folded yet.
 
@@ -147,14 +172,26 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
         ret = lambda t, rescued: t
     zero_u32 = jnp.zeros((), jnp.uint32)
 
-    def assemble(res, overlong, spill):
-        """Pair the final update with its chunk DataStats (stats mode)."""
+    def assemble(res, overlong, spill, cache=None, spill_gate=None):
+        """Pair the final update with its chunk DataStats (stats mode).
+
+        ``cache``/``spill_gate`` (ISSUE 11): the fused combiner's flushed
+        hot-key planes and the spill scalar that decided whether they
+        were USED — on a spilled chunk the pair fallback ran combiner-
+        free, so the counters gate to zero with it (the cache planes
+        exist outside the cond; reading them here adds no branch)."""
         if not with_stats:
             return res
         update, rescued = res
         tbl = update.batch if isinstance(update, SeamedUpdate) else update
         rescue_on = bool(config.rescue_slots)
         tiered = config.rescue_slots_max > config.rescue_slots > 0
+        c_hits = c_flushes = c_evicted = 0
+        if cache is not None:
+            used = (spill_gate == 0).astype(jnp.uint32)
+            c_hits = used * jnp.sum(cache.count)
+            c_flushes = used * jnp.sum((cache.count > 0).astype(jnp.uint32))
+            c_evicted = used * jnp.sum((cache.count == 1).astype(jnp.uint32))
         stats = datastats.map_stats(
             overlong=overlong, rescued=rescued,
             spill=spill if spill is not None else 0,
@@ -163,7 +200,9 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             escalated=(overlong > jnp.uint32(config.rescue_slots))
             if tiered else 0,
             dropped_tokens=tbl.dropped_count,
-            dropped_uniques=tbl.dropped_uniques)
+            dropped_uniques=tbl.dropped_uniques,
+            combiner_hits=c_hits, combiner_flushes=c_flushes,
+            combiner_evicted=c_evicted)
         return update, stats
 
     if config.resolved_backend() == "pallas":
@@ -223,24 +262,40 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
         concat_sort_mode = "sort3" if config.sort_mode == "stable2" \
             else config.sort_mode
 
-        def aggregate_stream(stream, overlong, mode):
+        def aggregate_stream(stream, overlong, mode, cache=None):
             """ONE packed build over a single complete stream — the shared
             tail of the split concat path and the fused map path (whose
             kernel already holds every emission, cross-lane-seam tokens
             hashed in-kernel from the seam-carry plane): no seam table, no
             seam merge, and overlong poison rows ride the big sort's
             poison segment (contrast aggregate_stable2's seam-poison
-            extraction dance)."""
+            extraction dance).  With ``cache`` (the fused combiner's
+            flushed hot-key planes, ISSUE 11) the occurrences the kernel
+            absorbed fold back in as one tiny exact table merge — counts
+            add, the merge keeps each key's smallest position, and the
+            merged result equals the uncombined build's bit-for-bit
+            (under batch-capacity spill both paths keep the same smallest
+            ``capacity`` keys: the build and the merge share one
+            largest-keys-drop rule; only the dropped_uniques upper bound
+            can differ, as cross-table merges always could)."""
             built = table_ops.from_stream(
                 stream, capacity, pos_hi=pos_hi,
                 max_token_bytes=config.pallas_max_token,
                 max_pos=int(chunk.shape[0]), sort_mode=mode,
                 rescue_slots=config.rescue_slots_max,
-                sort_impl=config.sort_impl)
+                sort_impl=config.sort_impl,
+                salt_bits=config.resolved_salt_bits)
             if not config.rescue_slots:
-                return ret(accounted(built, overlong), zero_u32)
-            t, rescue_packed = built
-            return rescued_table(t, rescue_packed, overlong)
+                res = ret(accounted(built, overlong), zero_u32)
+            else:
+                t, rescue_packed = built
+                res = rescued_table(t, rescue_packed, overlong)
+            if cache is None:
+                return res
+            t, resc = res if with_stats else (res, zero_u32)
+            t = table_ops.merge(t, _combiner_table(cache, pos_hi),
+                                capacity=capacity)
+            return ret(t, resc)
 
         def aggregate(col, seam, overlong):
             # One aggregation over column + seam emissions together: the
@@ -269,7 +324,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 max_token_bytes=config.pallas_max_token,
                 max_pos=int(chunk.shape[0]), sort_mode="stable2",
                 rescue_slots=config.rescue_slots_max,
-                sort_impl=config.sort_impl)
+                sort_impl=config.sort_impl,
+                salt_bits=config.resolved_salt_bits)
             seam_tbl = table_ops.from_stream(
                 seam,
                 min(capacity,
@@ -354,11 +410,26 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 res, overlong = fused_full_tok(None)
                 return assemble(res, overlong, None)
             lane_major = config.sort_mode == "stable2"
-            stream, overlong, spill = pallas_tok.tokenize_fused(
-                chunk, compact_slots=config.resolved_compact_slots,
-                max_token_bytes=config.pallas_max_token,
-                block_rows=config.resolved_block_rows,
-                lane_major=lane_major)
+            combiner_slots = config.resolved_combiner_slots
+            if combiner_slots:
+                # Hot-key combiner (ISSUE 11): the kernel counts cached
+                # occurrences in VMEM and thins the stream; the flushed
+                # cache folds back in inside the compact branch.  The
+                # spill fallback stays the combiner-FREE pair path — on a
+                # spilled chunk the aborted compact pass's cache is
+                # discarded wholesale, so exactness never depends on it.
+                stream, overlong, spill, cache = pallas_tok.tokenize_fused(
+                    chunk, compact_slots=config.resolved_compact_slots,
+                    max_token_bytes=config.pallas_max_token,
+                    block_rows=config.resolved_block_rows,
+                    lane_major=lane_major, combiner_slots=combiner_slots)
+            else:
+                stream, overlong, spill = pallas_tok.tokenize_fused(
+                    chunk, compact_slots=config.resolved_compact_slots,
+                    max_token_bytes=config.pallas_max_token,
+                    block_rows=config.resolved_block_rows,
+                    lane_major=lane_major)
+                cache = None
             # Lane-major fused streams stay in global byte-position order
             # (cross-seam tokens land in their start-position slot), so the
             # stable2 tie-order contract holds over the single stream.
@@ -366,8 +437,9 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             return assemble(jax.lax.cond(
                 spill == 0,
                 lambda _: seamed_ret(aggregate_stream(stream, overlong,
-                                                      mode)),
-                fused_full, None), overlong, spill)
+                                                      mode, cache=cache)),
+                fused_full, None), overlong, spill,
+                cache=cache, spill_gate=spill)
 
         if not config.resolved_compact_slots:
             res, overlong = full_tok(None)
@@ -484,7 +556,8 @@ def _ngram_step(data: jax.Array, capacity: int, n: int,
     gs = ngram_ops.mark_long_spans(tok_ops.ngrams(tok_ops.tokenize(data), n))
     return ngram_ops.gram_table(gs, capacity, 0, max_pos=data.shape[0],
                                 sort_mode=config.sort_mode,
-                                sort_impl=config.sort_impl)
+                                sort_impl=config.sort_impl,
+                                salt_bits=config.resolved_salt_bits)
 
 
 def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCountResult:
@@ -791,7 +864,8 @@ class NGramCountJob(WordCountJob):
         return ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
                                     max_pos=chunk.shape[0],
                                     sort_mode=self.config.sort_mode,
-                                    sort_impl=self.config.sort_impl)
+                                    sort_impl=self.config.sort_impl,
+                                    salt_bits=self.config.resolved_salt_bits)
 
     # -- exact cross-chunk grams (streamed runs) ----------------------------
 
@@ -821,7 +895,8 @@ class NGramCountJob(WordCountJob):
             t = ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
                                      max_pos=chunk.shape[0],
                                      sort_mode=self.config.sort_mode,
-                                     sort_impl=self.config.sort_impl)
+                                     sort_impl=self.config.sort_impl,
+                                     salt_bits=self.config.resolved_salt_bits)
             summ = ngram_ops.summary_from_stream(stream, chunk_id, self.n)
         gathered = jax.lax.all_gather(summ, axis_name=axis)  # leaves [D, n-1]
         return NGramUpdate(batch=t, summaries=gathered,

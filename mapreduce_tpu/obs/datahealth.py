@@ -98,6 +98,15 @@ def classify(data: dict) -> dict:
                                  data.get("capacity", 0)),
         "window_occupancy": _num(data.get("window_occupancy")),
         "rescue_escalations": _num(data.get("rescue_escalations", 0)),
+        # Map-side combiner telemetry (ISSUE 11): share of all tokens the
+        # hot-key cache absorbed, and the net sort rows it deleted.  Pure
+        # observability — no flag fires on them (the combiner is the CURE
+        # for skew-hot, not a symptom), but the skew-hot detail below
+        # points at the knob and the autotuner's enable-combiner rule
+        # reads the verdict.
+        "combiner_hit_rate": _frac(data.get("combiner_hits", 0),
+                                   data.get("tokens", 0)),
+        "combiner_rows_deleted": _num(data.get("combiner_rows_deleted")),
     }
     signals = {k: (round(v, 6) if v is not None else None)
                for k, v in signals.items()}
@@ -124,10 +133,15 @@ def classify(data: dict) -> dict:
              "--max-token-bytes / the rescue budgets for URL-dense text")
     tm = signals["top_mass"]
     if tm is not None and tm > TOP_MASS_HOT:
+        ch = signals["combiner_hit_rate"]
+        cure = (f"the map-side combiner is absorbing {ch:.1%} of the "
+                "stream" if ch else
+                "enable the map-side combiner (--combiner hot-cache, or "
+                "'auto' to let this verdict decide)")
         flag("skew-hot",
              f"the hottest key carries {tm:.1%} of all tokens "
-             "(Zipf-hot): key-range partitioning would load-imbalance — "
-             "prefer tree merge; sort runs will be long")
+             f"(Zipf-hot): {cure}; key-range partitioning would "
+             "load-imbalance — prefer tree merge")
     wo = signals["window_occupancy"]
     if wo is not None and wo < WINDOW_OCCUPANCY_FLOOR:
         flag("occupancy-starved",
@@ -172,3 +186,28 @@ def classify_run(records: Iterable[dict],
     data-health section", never to an error)."""
     rec = data_record(records, run_id)
     return classify(rec) if rec is not None else None
+
+
+def latest_data_record(records: Iterable[dict]) -> Optional[dict]:
+    """The LAST ``data`` record in a (possibly append-mode, multi-run)
+    ledger — the most recent completed measurement, which is what
+    history-driven decisions should read (contrast :func:`data_record`,
+    which serves per-run analysis and keys on the FIRST run)."""
+    last = None
+    for rec in records:
+        if isinstance(rec, dict) and rec.get("kind") == "data":
+            last = rec
+    return last
+
+
+def resolve_combiner(records: Iterable[dict]) -> str:
+    """Resolve ``Config.combiner='auto'`` against a prior run's ledger
+    (ISSUE 11): the most recent ``data`` record's verdict decides —
+    skew-hot flips the hot-key combiner on, anything else (including no
+    history at all) stays off.  The same flip the autotuner's
+    ``skew-hot -> enable-combiner`` rule proposes, packaged for drivers
+    that resolve BEFORE compiling (the CLI, service warm-starts)."""
+    rec = latest_data_record(records)
+    if rec is None:
+        return "off"
+    return "hot-cache" if classify(rec)["verdict"] == "skew-hot" else "off"

@@ -74,8 +74,11 @@ from typing import Iterator, Optional
 #: 3 = adds the per-run ``data`` record + per-group ``data`` dicts
 #: (ISSUE 8); 4 = adds the per-run ``tune`` record (ISSUE 10: the window
 #: autotuner's recommendation + decision trail, ``autotune='hint'`` runs
-#: only).
-LEDGER_VERSION = 4
+#: only); 5 = the ``data`` record and run_start gain the map-side
+#: combiner fields (ISSUE 11: ``combiner`` resolved mode,
+#: ``combiner_hits``/``combiner_flushes``/``combiner_evicted`` counters,
+#: ``combiner_hit_rate``/``combiner_rows_deleted`` derived ratios).
+LEDGER_VERSION = 5
 
 
 class RunLedger:

@@ -59,7 +59,16 @@ class DataStats(NamedTuple):
       ``lax.cond`` fallback branch taken — each one ~doubles that
       chunk's map cost);
     * ``spill_rows`` — emissions past the slot budget (the kernels' SMEM
-      spill scalar, summed).
+      spill scalar, summed);
+    * ``combiner_hits`` — occurrences the hot-key combiner cache absorbed
+      in VMEM (ISSUE 11: rows DELETED from the aggregation sort's input,
+      minus the flush rows below — zero when the combiner is off or the
+      chunk took the combiner-free spill fallback);
+    * ``combiner_flushes`` — resident cache entries re-emitted as exact
+      (key, count, first-occurrence) rows at chunk end;
+    * ``combiner_evicted`` — flushed entries with count 1: cold keys
+      whose slot bought nothing (every entry is evicted at the flush;
+      these are the wasted ones — the cache-efficacy signal).
 
     Gauges (running values off the post-group state, filled by
     ``job.state_stats``):
@@ -83,6 +92,9 @@ class DataStats(NamedTuple):
     rescue_escalations: jax.Array
     fallback_chunks: jax.Array
     spill_rows: jax.Array
+    combiner_hits: jax.Array
+    combiner_flushes: jax.Array
+    combiner_evicted: jax.Array
     table_valid: jax.Array
     total_lo: jax.Array
     total_hi: jax.Array
@@ -96,7 +108,8 @@ _N_FIELDS = len(DataStats._fields)
 #: Fields summed per chunk at trace time (everything before the gauges).
 _COUNTERS = ("chunks", "overlong", "rescued", "dropped_tokens",
              "dropped_uniques", "rescue_invocations", "rescue_escalations",
-             "fallback_chunks", "spill_rows")
+             "fallback_chunks", "spill_rows", "combiner_hits",
+             "combiner_flushes", "combiner_evicted")
 
 
 def zeros() -> DataStats:
@@ -110,7 +123,8 @@ def _u32(x) -> jax.Array:
 
 def map_stats(*, overlong=0, rescued=0, spill=0, fallback=0,
               invoked=0, escalated=0, dropped_tokens=0,
-              dropped_uniques=0) -> DataStats:
+              dropped_uniques=0, combiner_hits=0, combiner_flushes=0,
+              combiner_evicted=0) -> DataStats:
     """One chunk's counter delta (gauges zero; ``state_stats`` fills them
     after the group's last combine).  All arguments accept uint32 scalars
     or Python ints; predicates arrive as 0/1 values."""
@@ -120,7 +134,10 @@ def map_stats(*, overlong=0, rescued=0, spill=0, fallback=0,
         spill_rows=_u32(spill), fallback_chunks=_u32(fallback),
         rescue_invocations=_u32(invoked), rescue_escalations=_u32(escalated),
         dropped_tokens=_u32(dropped_tokens),
-        dropped_uniques=_u32(dropped_uniques))
+        dropped_uniques=_u32(dropped_uniques),
+        combiner_hits=_u32(combiner_hits),
+        combiner_flushes=_u32(combiner_flushes),
+        combiner_evicted=_u32(combiner_evicted))
 
 
 def add(a: DataStats, b: DataStats) -> DataStats:
@@ -196,11 +213,13 @@ class DataAggregator:
 
     def __init__(self, *, capacity: int, devices: int,
                  backend: str, map_impl: str,
-                 slot_capacity_per_chunk: int | None = None):
+                 slot_capacity_per_chunk: int | None = None,
+                 combiner: str = "off"):
         self.capacity = int(capacity)
         self.devices = int(devices)
         self.backend = backend
         self.map_impl = map_impl
+        self.combiner = combiner
         self.slot_capacity = slot_capacity_per_chunk
         self.groups = 0
         self.totals = {k: 0 for k in _COUNTERS}
@@ -211,7 +230,8 @@ class DataAggregator:
         return cls(capacity=config.table_capacity, devices=devices,
                    backend=config.resolved_backend(),
                    map_impl=config.map_impl,
-                   slot_capacity_per_chunk=window_slot_capacity(config))
+                   slot_capacity_per_chunk=window_slot_capacity(config),
+                   combiner=config.resolved_combiner)
 
     def group_data(self, stats_host: DataStats) -> dict:
         """One retired group's [D]-leaf stats -> the ``group`` record's
@@ -250,7 +270,7 @@ class DataAggregator:
     def run_record(self) -> dict:
         """The per-run ``data`` ledger record (docs/observability.md)."""
         rec: dict = {"groups": self.groups, "backend": self.backend,
-                     "map_impl": self.map_impl,
+                     "map_impl": self.map_impl, "combiner": self.combiner,
                      "capacity": self.capacity * self.devices}
         rec.update(self.totals)
         f = self.final
@@ -265,8 +285,20 @@ class DataAggregator:
             rec["top_mass"] = round(rec["top_count"] / tokens, 6)
             rec["distinct_ratio"] = round(rec["table_valid"] / tokens, 6)
             rec["dropped_frac"] = round(rec["dropped_tokens"] / tokens, 6)
+            if rec["combiner_hits"]:
+                # Share of all tokens the cache absorbed, and the sort
+                # rows it deleted net of the flush rows it re-emitted.
+                rec["combiner_hit_rate"] = round(
+                    rec["combiner_hits"] / tokens, 6)
+                rec["combiner_rows_deleted"] = \
+                    rec["combiner_hits"] - rec["combiner_flushes"]
         if self.slot_capacity and self.totals["chunks"] and tokens:
             cap = self.slot_capacity * self.totals["chunks"]
             rec["window_slot_capacity"] = cap
-            rec["window_occupancy"] = round(tokens / cap, 4)
+            # Combiner-absorbed occurrences never occupied a window slot
+            # (they were counted in the cache, not emitted): the occupancy
+            # numerator is the rows the windows actually carried, so the
+            # occupancy-starved signal stays meaningful with the cache on.
+            emitted = max(tokens - rec["combiner_hits"], 0)
+            rec["window_occupancy"] = round(emitted / cap, 4)
         return rec

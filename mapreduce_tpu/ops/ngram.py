@@ -141,7 +141,8 @@ def mark_long_spans(stream: TokenStream) -> TokenStream:
 
 def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
                max_pos: int, sort_mode: str = "stable2",
-               sort_impl: str = "xla") -> table_ops.CountTable:
+               sort_impl: str = "xla",
+               salt_bits: int = 0) -> table_ops.CountTable:
     """Aggregate a position-ordered gram stream into a count table.
 
     Both backends' gram streams arrive in ascending start-position order
@@ -160,6 +161,15 @@ def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
     chunk length (NOT the stream row count: the pallas kernel's compacted
     stream has ~3x fewer rows than chunk bytes, but its positions still
     span the whole chunk).
+
+    ``salt_bits`` (``Config.combiner='salt'``, ISSUE 11): a
+    single-hot-gram stream is exactly as pathological for the radix slab
+    path as a single hot word, so the salt tier rides the shared packed
+    build — spread over salted segments, de-salted exactly at the reduce
+    (:func:`...ops.table.from_packed_rows`).  The gram family's hot-key
+    CACHE tier does not exist: deleting duplicate tokens would break the
+    position adjacency grams are formed from, so 'hot-cache' is a
+    documented no-op here.
     """
     # pos << 7 needs pos < 2**25; the padded chunk length is a trace-time
     # constant, so the gate is static.  (The generic fallback ignores
@@ -185,7 +195,8 @@ def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
     # along so the gram family inherits the radix A/B with no extra knob.
     t = table_ops.from_packed_rows(
         gs.key_hi, gs.key_lo, packed, jnp.sum(gs.count), capacity, pos_hi,
-        len_bits=7, sort_mode=sort_mode, sort_impl=sort_impl)
+        len_bits=7, sort_mode=sort_mode, sort_impl=sort_impl,
+        salt_bits=salt_bits)
     occ = t.occupied()
     return t._replace(length=jnp.where(
         occ & (t.length == jnp.uint32(127)),
@@ -232,7 +243,8 @@ def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
     key_hi, key_lo, packed = position_sorted(stream)
     gs = mark_long_spans(grams_from_sorted(key_hi, key_lo, packed, n))
     t = gram_table(gs, capacity, pos_hi, max_pos=chunk.shape[0],
-                   sort_mode=config.sort_mode, sort_impl=config.sort_impl)
+                   sort_mode=config.sort_mode, sort_impl=config.sort_impl,
+                   salt_bits=config.resolved_salt_bits)
     # Live sorted rows = real tokens + one poison row per overlong end.
     all_tokens = stream.total + overlong
     nm1 = jnp.uint32(n - 1)

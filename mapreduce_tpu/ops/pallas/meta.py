@@ -120,6 +120,9 @@ def production_plans() -> list[VmemPlan]:
         tokenize.vmem_plan(block_rows=256, compact_slots=0),   # pair path
         tokenize.vmem_plan(block_rows=384, compact_slots=128,
                            lane_major=True, fused=True),  # fused map path
+        tokenize.vmem_plan(block_rows=512, compact_slots=128,
+                           lane_major=True, fused=True,
+                           combiner_slots=8),  # hot-key combiner (ISSUE 11)
         tokenize.vmem_plan(block_rows=256, compact_slots=0,
                            fused=True),        # fused spill fallback (pair)
         radix.vmem_plan(),                                     # default B=8

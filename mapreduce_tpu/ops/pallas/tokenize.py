@@ -82,12 +82,16 @@ meta.register(meta.KernelMeta(
 
 def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
               compact_slots: int = 0, w: int = DEFAULT_MAX_TOKEN,
-              lane_major: bool = False, fused: bool = False) -> meta.VmemPlan:
+              lane_major: bool = False, fused: bool = False,
+              combiner_slots: int = 0) -> meta.VmemPlan:
     """Static VMEM/SMEM footprint of one tokenize-kernel geometry, from
     the same BlockSpec/scratch arithmetic :func:`_column_pass` binds —
     the analyzer's metadata hook (ops/pallas/meta.py).  ``fused`` adds the
     seam-carry aux plane and the in-VMEM transposed byte block of the
-    fused map path."""
+    fused map path; ``combiner_slots`` the hot-key cache's four
+    ``(C, LANES)`` planes (ISSUE 11 — cache state lives in revisited
+    output blocks, the spill-scalar idiom, so it is pipelined like any
+    other output)."""
     out_rows = compact_slots if compact_slots else block_rows // 2
     n_scalars = 3 if compact_slots else 2
     bufs = [meta.Buffer("bytes-in", "vmem", block_rows * LANES, True)]
@@ -101,14 +105,42 @@ def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
                          True) for i in range(3)]
     bufs += [meta.Buffer(f"scalar[{i}]", "smem", 4, False)
              for i in range(n_scalars)]
+    if combiner_slots:
+        bufs += [meta.Buffer(f"combiner-cache[{name}]", "vmem",
+                             combiner_slots * LANES * 4, True)
+                 for name in ("key_hi", "key_lo", "count", "packed")]
     bufs.append(meta.Buffer("carry-scratch", "vmem", (w + 1) * LANES * 4,
                             False))
     geom = (f"block_rows={block_rows} w={w} slots={compact_slots or 'pair'}"
             + (" lane-major" if lane_major else "")
-            + (" fused" if fused else ""))
+            + (" fused" if fused else "")
+            + (f" combiner={combiner_slots}" if combiner_slots else ""))
     return meta.VmemPlan(
         kernel="_tokenize_kernel", geometry=geom, buffers=tuple(bufs),
         vmem_limit_bytes=64 * 1024 * 1024 if compact_slots else None)
+
+
+class CombinerCache(NamedTuple):
+    """Flushed hot-key cache planes of one chunk (ISSUE 11): per lane, up
+    to C resident entries — the first C distinct keys the lane saw, every
+    occurrence of which was counted here instead of emitted.  All planes
+    are ``(C, LANES)`` uint32; ``count == 0`` marks a never-filled slot
+    (sentinel keys).  ``packed`` is the entry's FIRST in-lane occurrence
+    (``start << 6 | len``), so a table built from these rows merges with
+    the thinned stream's table bit-identically to the uncombined build
+    (counts add exactly; the merge keeps each key's smallest position).
+
+    Host-derivable telemetry (no extra kernel counters needed):
+    ``hits = count.sum()`` occurrences absorbed, ``flushes = (count >
+    0).sum()`` rows re-emitted at the flush, ``evicted = (count ==
+    1).sum()`` cold entries whose slot bought nothing (the flush is where
+    every entry is evicted; count-1 entries are the wasted ones).
+    """
+
+    key_hi: jax.Array
+    key_lo: jax.Array
+    count: jax.Array
+    packed: jax.Array
 
 
 class PackedTokenStream(NamedTuple):
@@ -220,7 +252,7 @@ def _compact_planes(khi, klo, packed, has, slots: int):
 
 def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
                      compact_slots: int = 0, lane_major: bool = False,
-                     fused: bool = False):
+                     fused: bool = False, combiner_slots: int = 0):
     """One grid step: emit pair-compacted (key_hi, key_lo, packed) planes.
 
     Logical output row t of block i describes byte-row ``m = i*block_rows +
@@ -251,7 +283,8 @@ def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
     """
     # Positional refs: the optional seam-carry aux input (fused mode),
     # the three planes + two scalars, then the optional spill scalar
-    # (compact mode only) and the carry scratch.
+    # (compact mode only), the optional combiner cache planes, and the
+    # carry scratch.
     if fused:
         aux_ref, refs = refs[0], refs[1:]
     else:
@@ -259,9 +292,12 @@ def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
     khi_ref, klo_ref, packed_ref, over_ref, ntok_ref = refs[:5]
     refs = refs[5:]
     if compact_slots:
-        spill_ref, carry_ref = refs
+        spill_ref, refs = refs[0], refs[1:]
     else:
-        spill_ref, (carry_ref,) = None, refs
+        spill_ref = None
+    if combiner_slots:
+        (ckhi_ref, cklo_ref, ccnt_ref, cpk_ref), refs = refs[:4], refs[4:]
+    (carry_ref,) = refs
     i = pl.program_id(0)
     tb = block_rows
     aux = aux_ref[:].astype(jnp.int32) if fused else None
@@ -282,6 +318,18 @@ def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
         ntok_ref[0, 0] = jnp.uint32(0)
         if spill_ref is not None:
             spill_ref[0, 0] = jnp.uint32(0)
+        if combiner_slots:
+            # Hot-key cache state rides REVISITED output blocks (index map
+            # pinned to (0, 0)) under the guarded-init + read-modify-write
+            # discipline the kernel-race pass certifies — the spill-scalar
+            # idiom widened to planes.  After the last grid step the refs
+            # hold the flushed cache verbatim: no separate flush pass.
+            ckhi_ref[:] = jnp.full_like(ckhi_ref,
+                                        jnp.uint32(constants.SENTINEL_KEY))
+            cklo_ref[:] = jnp.full_like(cklo_ref,
+                                        jnp.uint32(constants.SENTINEL_KEY))
+            ccnt_ref[:] = jnp.zeros_like(ccnt_ref)
+            cpk_ref[:] = jnp.full_like(cpk_ref, jnp.uint32(0xFFFFFFFF))
 
     # Widen bytes to int32 immediately: v5e Mosaic has no 8-bit vector
     # compares, and 32-bit lanes are the VPU-native layout anyway.  The
@@ -359,6 +407,72 @@ def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
     at_sent = (khi == sent) & (klo >= sent - jnp.uint32(1))
     klo = jnp.where(at_sent, sent - jnp.uint32(2), klo)
 
+    if combiner_slots:
+        # Map-side hot-key combiner (ISSUE 11): per lane, emissions whose
+        # key is resident in the cache are COUNTED here and suppressed
+        # from the stream; empty slots greedily adopt the first-seen
+        # distinct keys (on Zipf streams the top-mass keys appear within
+        # the first windows with overwhelming probability — PR 8's
+        # top_mass proxy is exactly the collapsible mass).  Every update
+        # is a static C-slot loop of lane-wise compares + sublane
+        # reductions: no scatter, no data-dependent control flow.  Exact
+        # by construction — a missed key flows to the sort unchanged, a
+        # cached key's count and first in-lane occurrence flush at chunk
+        # end — so results are bit-identical on every distribution.
+        row = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 0)
+        lane_c = jax.lax.broadcasted_iota(jnp.int32, (tb, LANES), 1)
+        start_raw = lane_c * data_rows + m + 1 - ln.astype(jnp.int32)
+        packed_raw = (start_raw.astype(jnp.uint32) << 6) | ln
+        ck = ckhi_ref[:]
+        cl = cklo_ref[:]
+        cc = ccnt_ref[:]
+        cp = cpk_ref[:]
+        ck_rows = [ck[c:c + 1, :] for c in range(combiner_slots)]
+        cl_rows = [cl[c:c + 1, :] for c in range(combiner_slots)]
+        cc_rows = [cc[c:c + 1, :] for c in range(combiner_slots)]
+        cp_rows = [cp[c:c + 1, :] for c in range(combiner_slots)]
+        # Hit pass: resident keys absorb their occurrences.  Sentinel
+        # slots can never match — emissions carry clamped keys, so an
+        # emitting row's (khi, klo) is never (sent, sent).
+        for c in range(combiner_slots):
+            m_hit = emit & (khi == ck_rows[c]) & (klo == cl_rows[c])
+            n_hit = jnp.sum(m_hit.astype(jnp.int32), axis=0, keepdims=True)
+            cc_rows[c] = cc_rows[c] + n_hit.astype(jnp.uint32)
+            emit = emit & ~m_hit
+        # Fill pass: each empty slot adopts the lane's first remaining
+        # live emission (per-lane one-hot select via a masked int32 sum —
+        # bit-exact, the sum has at most one nonzero term), records its
+        # first occurrence, and absorbs its other occurrences in this
+        # block.  Slots only ever fill, so an adopted entry's ``packed``
+        # is provably the key's first in-lane occurrence: were the key
+        # seen earlier with this slot empty, it would have been adopted
+        # then.
+        big = jnp.int32(tb + 1)
+        for c in range(combiner_slots):
+            empty = cc_rows[c] == 0
+            cand = jnp.where(emit, row, big)
+            idx = jnp.min(cand, axis=0, keepdims=True)
+            take = empty & (idx < big)
+            pick = emit & (row == idx)
+
+            def sel(v):
+                return jnp.sum(jnp.where(pick, v.astype(jnp.int32), 0),
+                               axis=0, keepdims=True).astype(jnp.uint32)
+
+            nk_hi, nk_lo, npk = sel(khi), sel(klo), sel(packed_raw)
+            m_new = emit & take & (khi == nk_hi) & (klo == nk_lo)
+            n_new = jnp.sum(m_new.astype(jnp.int32), axis=0, keepdims=True)
+            ck_rows[c] = jnp.where(take, nk_hi, ck_rows[c])
+            cl_rows[c] = jnp.where(take, nk_lo, cl_rows[c])
+            cp_rows[c] = jnp.where(take, npk, cp_rows[c])
+            cc_rows[c] = jnp.where(take, n_new.astype(jnp.uint32),
+                                   cc_rows[c])
+            emit = emit & ~m_new
+        ckhi_ref[:] = jnp.concatenate(ck_rows, axis=0)
+        cklo_ref[:] = jnp.concatenate(cl_rows, axis=0)
+        ccnt_ref[:] = jnp.concatenate(cc_rows, axis=0)
+        cpk_ref[:] = jnp.concatenate(cp_rows, axis=0)
+
     khi = jnp.where(emit, khi, sent)
     # Poison rows carry the reserved key (sent, sent-1): they sort into
     # their OWN segment immediately before the dead-filler segment, so the
@@ -428,7 +542,8 @@ def _tokenize_kernel(x_ref, *refs, w: int, block_rows: int, data_rows: int,
 
 def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
                  data_rows: int, interpret: bool, compact_slots: int = 0,
-                 lane_major: bool = False, fused_aux: jax.Array | None = None):
+                 lane_major: bool = False, fused_aux: jax.Array | None = None,
+                 combiner_slots: int = 0):
     """Run the kernel over the (rows, 128) column view (one trailing pad block).
 
     Returns pair-compacted planes of rows//2 output rows — or, with
@@ -449,7 +564,8 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
     grid = rows // block_rows
     kern = functools.partial(_tokenize_kernel, w=w, block_rows=block_rows,
                              data_rows=data_rows, compact_slots=compact_slots,
-                             lane_major=lane_major, fused=fused)
+                             lane_major=lane_major, fused=fused,
+                             combiner_slots=combiner_slots)
     out_rows = grid * compact_slots if compact_slots else rows // 2
     block_out = compact_slots if compact_slots else block_rows // 2
     if lane_major:
@@ -485,21 +601,34 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
         in_specs = [pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                  memory_space=pltpu.VMEM)]
         args = (cols_padded,)
+    cache_shapes: list = []
+    cache_specs: list = []
+    if combiner_slots:
+        # Cache state lives in revisited VMEM output blocks (index map
+        # pinned to (0, 0)): the refs carry the cache across the
+        # sequential grid, and their post-kernel value IS the flush.
+        cache_shapes = [jax.ShapeDtypeStruct((combiner_slots, LANES),
+                                             jnp.uint32)] * 4
+        cache_specs = [pl.BlockSpec((combiner_slots, LANES),
+                                    lambda i: (0, 0),
+                                    memory_space=pltpu.VMEM)] * 4
     outs = pl.pallas_call(
         kern,
         grid=(grid,),
         in_specs=in_specs,
-        out_shape=[out32, out32, out32] + [scalar] * n_scalars,
+        out_shape=[out32, out32, out32] + [scalar] * n_scalars + cache_shapes,
         out_specs=[plane_spec] * 3
         + [pl.BlockSpec((1, 1), lambda i: (0, 0),
-                        memory_space=pltpu.SMEM)] * n_scalars,
+                        memory_space=pltpu.SMEM)] * n_scalars + cache_specs,
         scratch_shapes=[pltpu.VMEM((w + 1, LANES), jnp.int32)],
         compiler_params=params,
         interpret=interpret,
     )(*args)
     khi, klo, packed, over, ntok = outs[:5]
     spill = outs[5][0, 0] if compact_slots else jnp.uint32(0)
-    return khi, klo, packed, over[0, 0], ntok[0, 0], spill
+    cache = CombinerCache(*outs[3 + n_scalars:3 + n_scalars + 4]) \
+        if combiner_slots else None
+    return khi, klo, packed, over[0, 0], ntok[0, 0], spill, cache
 
 
 def _seam_pass(data: jax.Array, seg_len: int, w: int,
@@ -727,7 +856,7 @@ def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
     cols_padded = jnp.concatenate(
         [cols, jnp.full((pad_rows, LANES), constants.PAD_BYTE, dtype=jnp.uint8)])
 
-    khi, klo, packed, over_cols, n_tokens, spill = _column_pass(
+    khi, klo, packed, over_cols, n_tokens, spill, _ = _column_pass(
         cols_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
         compact_slots=compact_slots, lane_major=lane_major)
 
@@ -759,8 +888,8 @@ def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
                    max_token_bytes: int = DEFAULT_MAX_TOKEN,
                    block_rows: int | None = None,
                    interpret: bool | None = None,
-                   lane_major: bool = False
-                   ) -> tuple[PackedTokenStream, jax.Array, jax.Array]:
+                   lane_major: bool = False,
+                   combiner_slots: int = 0):
     """Fully fused map path (ISSUE 6): ``(stream, overlong, spill)`` from
     ONE kernel pass over the raw chunk bytes — no XLA transpose/pad of the
     input, no seam fix-up pass, no separate seam stream.
@@ -780,21 +909,50 @@ def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
     means the compact planes are incomplete and the caller MUST fall back
     to an exact path under ``lax.cond`` (the fused fallback is this same
     kernel in pair mode — ``compact_slots=0``).
+
+    ``combiner_slots`` = C > 0 (ISSUE 11; requires ``compact_slots``)
+    threads the per-lane hot-key cache through the grid and returns
+    ``(stream, overlong, spill, cache)``: cached occurrences are counted
+    in VMEM and ABSENT from the stream (``stream.total`` counts only
+    emitted rows), and the caller folds the flushed :class:`CombinerCache`
+    back in exactly (one table row per resident entry).  The occurrence
+    union of stream + cache equals the C=0 stream's exactly, cache misses
+    included byte-for-byte — the bit-identity contract of
+    ``Config.combiner='hot-cache'``.
     """
     w, seg_len, block_rows, interpret = _resolve_args(
         data, max_token_bytes, block_rows, interpret, compact_slots)
+    if combiner_slots:
+        if not compact_slots:
+            raise ValueError("combiner_slots requires the compact path "
+                             "(the pair fallback is the combiner-free "
+                             "exactness escape)")
+        if combiner_slots % 8 or not 8 <= combiner_slots <= 32:
+            raise ValueError(f"combiner_slots must be a multiple of 8 in "
+                             f"[8, 32], got {combiner_slots}")
+        if not (isinstance(base_offset, int) and base_offset == 0):
+            # The cache's `packed` plane records raw in-chunk positions
+            # (the same rule that nulls PackedTokenStream.packed under a
+            # nonzero base): offsetting the stream but not the cache would
+            # silently skew cached first occurrences by base_offset.
+            raise ValueError("combiner_slots requires base_offset == 0 "
+                             "(the cache flush records in-chunk positions; "
+                             "callers apply chunk bases via pos_hi, the "
+                             "wordcount idiom)")
     view = data.reshape(LANES, seg_len)
     # Pad lane columns to a whole number of blocks plus one extra pad block
     # (outputs trail by one row, exactly like the split column view).
     pad_cols = (-seg_len) % block_rows + block_rows
     view_padded = jnp.pad(view, ((0, 0), (0, pad_cols)),
                           constant_values=constants.PAD_BYTE)
-    khi, klo, packed, overlong, n_tokens, spill = _column_pass(
+    khi, klo, packed, overlong, n_tokens, spill, cache = _column_pass(
         view_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
         compact_slots=compact_slots, lane_major=lane_major,
-        fused_aux=_seam_aux(view, w))
-    return _packed_stream(khi, klo, packed, n_tokens, base_offset), \
-        overlong, spill
+        fused_aux=_seam_aux(view, w), combiner_slots=combiner_slots)
+    stream = _packed_stream(khi, klo, packed, n_tokens, base_offset)
+    if combiner_slots:
+        return stream, overlong, spill, cache
+    return stream, overlong, spill
 
 
 def concat_streams(col: PackedTokenStream, seam: TokenStream) -> PackedTokenStream:

@@ -318,7 +318,8 @@ def _build(key_hi, key_lo, pos_hi, pos_lo, count, count_hi, length,
 def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
                      total: jax.Array, capacity: int, pos_hi: jax.Array | int,
                      len_bits: int = 6, sort_mode: str = "sort3",
-                     rescue_slots: int = 0, sort_impl: str = "xla"):
+                     rescue_slots: int = 0, sort_impl: str = "xla",
+                     salt_bits: int = 0):
     """Aggregate pre-packed single-occurrence rows (the sort-lean path).
 
     ``packed`` = ``pos << len_bits | length`` per live row (all-ones for
@@ -374,11 +375,40 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     implementation serves both modes, poison-segment rescue extraction
     included.  segmin is xla-only.
 
+    With ``salt_bits`` = B > 0 (``Config.combiner='salt'``, ISSUE 11: the
+    pathological-single-key-stream tier below the hot-key cache), the low
+    B position bits are XORed into ``key_lo`` BEFORE the sort — one
+    scorching key spreads over 2**B segments, defeating the measured ~4x
+    radix hot-key slab amplification — and the built table is de-salted
+    (the XOR is recoverable: every row of a salted segment shares
+    ``pos & (2**B - 1)``, so the kept head row's position undoes it) and
+    re-reduced through the generic build, coalescing the <= 2**B salted
+    entries per original key with exact counts and the true minimum
+    first occurrence.  Reserved-key rows (``key_hi == sent``: filler and
+    poison) are never salted, so the poison-segment rescue extraction is
+    untouched.  Exactness envelope, both legs documented: (1) two
+    DISTINCT hash keys that differ only by a legal salt XOR would
+    coalesce — a 2**B-fold widening of the documented ~n^2/2^65 64-bit
+    key-collision envelope (detectable by --verify-sample, as ever); the
+    single-key streams salting exists for cannot collide at all.  (2)
+    Bit-identity to the unsalted build holds while distinct keys FIT
+    ``capacity``: under unique overflow the capacity cutoff falls on the
+    SALTED key order, so the kept set — and a straddling key's kept
+    count — can differ from the unsalted build's (occurrence
+    conservation still holds exactly through ``dropped_count``; this is
+    the cross-table-merge dropped-accounting caveat the streamed paths
+    already document, not silent loss).  segmin is refused (its payload
+    scan keeps no per-salt-segment order to de-salt from).
+
     Matches :func:`_build` output bit-for-bit under its preconditions (every
     live row has count 1, one shared pos_hi).
     """
     if sort_mode not in ("sort3", "stable2", "segmin"):
         raise ValueError(f"unknown sort_mode {sort_mode!r}")
+    if salt_bits and sort_mode == "segmin":
+        raise ValueError("salt_bits requires sort_mode='sort3' or 'stable2'")
+    if not 0 <= salt_bits <= 6:
+        raise ValueError(f"salt_bits must be in [0, 6], got {salt_bits}")
     if sort_impl not in ("xla", "radix", "radix_partition"):
         raise ValueError(f"unknown sort_impl {sort_impl!r}")
     if sort_impl != "xla" and sort_mode == "segmin":
@@ -401,6 +431,16 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
     inf = jnp.uint32(constants.POS_INF)
     n = key_hi.shape[0]
     len_mask = jnp.uint32((1 << len_bits) - 1)
+
+    if salt_bits:
+        # Salt = the row's low position bits (every salted segment is then
+        # position-homogeneous in those bits — the de-salt invariant).
+        # key_hi == sent rows (dead filler, poison, and the rare clamped
+        # real keys) pass through unsalted, keeping the reserved segments
+        # and the poison binary search byte-identical.
+        smask = jnp.uint32((1 << salt_bits) - 1)
+        key_lo = jnp.where(key_hi != sent,
+                           key_lo ^ ((packed >> len_bits) & smask), key_lo)
 
     if sort_mode == "segmin":
         key_hi, key_lo, packed = jax.lax.sort(
@@ -476,6 +516,21 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
         dropped_uniques=dropped_uniques, dropped_count=dropped_count,
         dropped_uniques_hi=zero, dropped_count_hi=zero,
     )
+    if salt_bits:
+        # De-salt at the reduce seam: each kept row's position carries its
+        # own salt (all rows of a salted segment share the low position
+        # bits), so one XOR recovers the original key and a capacity-sized
+        # generic re-build coalesces the <= 2**salt_bits entries per key —
+        # exact counts, the true minimum first occurrence, dropped_*
+        # carried through.  Noise next to the stream sort it de-skews.
+        smask = jnp.uint32((1 << salt_bits) - 1)
+        live = table.key_hi != sent
+        desalted_lo = jnp.where(live, table.key_lo ^ (table.pos_lo & smask),
+                                table.key_lo)
+        table = _build(table.key_hi, desalted_lo, table.pos_hi, table.pos_lo,
+                       table.count, table.count_hi, table.length, capacity,
+                       table.dropped_uniques, table.dropped_uniques_hi,
+                       table.dropped_count, table.dropped_count_hi)
     if not rescue_slots:
         return table
     # Poison-segment head (reserved key (sent, sent-1), immediately before
@@ -496,7 +551,7 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
 def _from_stream_packed(stream: TokenStream, capacity: int,
                         pos_hi: jax.Array | int,
                         sort_mode: str = "sort3", rescue_slots: int = 0,
-                        sort_impl: str = "xla"):
+                        sort_impl: str = "xla", salt_bits: int = 0):
     """Packed fast path for token streams: see :func:`from_packed_rows`."""
     # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
     # feed their raw plane straight into the sort — repacking from
@@ -513,14 +568,14 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     return from_packed_rows(stream.key_hi, stream.key_lo, packed, total,
                             capacity, pos_hi, len_bits=6,
                             sort_mode=sort_mode, rescue_slots=rescue_slots,
-                            sort_impl=sort_impl)
+                            sort_impl=sort_impl, salt_bits=salt_bits)
 
 
 def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
                 max_token_bytes: int | None = None,
                 max_pos: int | None = None,
                 sort_mode: str = "sort3", rescue_slots: int = 0,
-                sort_impl: str = "xla"):
+                sort_impl: str = "xla", salt_bits: int = 0):
     """Aggregate a per-byte :class:`TokenStream` into a fresh table.
 
     ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
@@ -536,15 +591,22 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
     return ``(table, rescue_packed)``.  ``sort_impl`` picks the fast
     path's sort implementation (:func:`from_packed_rows`); the generic
     7-array build below keeps ``lax.sort`` — the radix seam covers the
-    packed stream, which is the measured single-chip floor.
+    packed stream, which is the measured single-chip floor.  ``salt_bits``
+    (fast path only, ``Config.combiner='salt'``) spreads hot keys over
+    salted sort segments with an exact de-salting re-reduce
+    (:func:`from_packed_rows`).
     """
     if (max_token_bytes is not None and max_token_bytes <= 63
             and max_pos is not None and max_pos <= (1 << 26)):
         return _from_stream_packed(stream, capacity, pos_hi, sort_mode,
-                                   rescue_slots, sort_impl)
+                                   rescue_slots, sort_impl, salt_bits)
     if rescue_slots:
         raise ValueError("rescue_slots requires the packed fast path "
                          "(bounded max_token_bytes/max_pos)")
+    if salt_bits:
+        raise ValueError("salt_bits applies to the packed fast path only "
+                         "(the generic 7-array build has no slab "
+                         "amplification to de-skew)")
     n = stream.key_hi.shape[0]
     ph = jnp.full((n,), jnp.asarray(pos_hi, dtype=jnp.uint32))
     ph = jnp.where(stream.count > 0, ph, jnp.uint32(constants.POS_INF))

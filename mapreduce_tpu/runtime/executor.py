@@ -1034,6 +1034,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      superstep=config.superstep,
                      backend=config.resolved_backend(),
                      map_impl=config.map_impl,
+                     combiner=config.resolved_combiner,
                      merge_strategy=merge_strategy, input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      retry=retry)
@@ -1222,6 +1223,7 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                          superstep=config.superstep,
                          backend=config.resolved_backend(),
                          map_impl=config.map_impl,
+                         combiner=config.resolved_combiner,
                          merge_strategy=merge_strategy,
                          input=_path_names(path),
                          resume_step=start_step, resume_offset=start_offset)

@@ -5,10 +5,10 @@ PR 5 built the bounded in-flight dispatch window, PR 7 the measured
 critical-path ``bottleneck`` verdict, PR 8 the ``data_health`` verdict —
 both documented as "the fitness signal the window autotuner reads".  This
 module closes the loop: a **pure, deterministic function of ledger
-records** proposes the next values for the four pipeline knobs
+records** proposes the next values for the tuned knobs
 
     ``inflight_groups`` / ``prefetch_depth`` / ``superstep`` /
-    ``chunk_bytes``
+    ``chunk_bytes`` / ``combiner``
 
 via a verdict-keyed rule table (below), in the spirit of CUDA-LLM's
 search-loop-with-a-certifier-as-fitness-gate and the config-search framing
@@ -31,6 +31,7 @@ cap instead of proposing a no-op):
 rule                trigger                                  move
 ==================  =======================================  ============
 no-signal           no phases/pipeline/timeline at all       stop
+enable-combiner     data verdict ``skew-hot``, combiner off  combiner on
 grow-chunk          data verdict ``occupancy-starved``       chunk ×2
 shrink-chunk        data verdict ``table-pressure``          chunk ÷2
 converged           projected bottleneck saving < 10% span   stop
@@ -43,9 +44,12 @@ no-rule             nothing actionable (e.g. ``retire``)     stop
 ==================  =======================================  ============
 
 Data-shape verdicts whose knobs are OUTSIDE the tuned set (spill-bound →
-``--compact-slots``, rescue-heavy → the rescue budgets, skew-hot → merge
-strategy) are noted in the decision trail but never produce a move: the
-tuner must not thrash pipeline knobs to chase a data problem.  The
+``--compact-slots``, rescue-heavy → the rescue budgets) are noted in the
+decision trail but never produce a move: the tuner must not thrash
+pipeline knobs to chase a data problem.  skew-hot GRADUATED from that
+set in ISSUE 11: the ``combiner`` knob is tuned now, so the
+``enable-combiner`` rule flips the map-side hot-key cache on instead of
+just pointing at it.  The
 ``table-pressure`` move is deliberately modest for the same reason — the
 real knob is ``--table-capacity``, which is not tuned here; halving the
 chunk shrinks the per-merge batch table that competes for slots, and the
@@ -70,8 +74,15 @@ from mapreduce_tpu.obs import datahealth, timeline
 #: Bumped when the rule table / proposal schema changes shape.
 TUNER_VERSION = 1
 
-#: The knobs this tuner owns, in proposal order.
-KNOBS = ("inflight_groups", "prefetch_depth", "superstep", "chunk_bytes")
+#: The knobs this tuner owns, in proposal order.  ``combiner`` is the one
+#: non-numeric knob (ISSUE 11): a mode string moved by the data-shape
+#: rules, not doubled/halved by the pipeline ones.
+KNOBS = ("inflight_groups", "prefetch_depth", "superstep", "chunk_bytes",
+         "combiner")
+
+#: Knobs that hold integers (everything result() must int-coerce).
+_INT_KNOBS = ("inflight_groups", "prefetch_depth", "superstep",
+              "chunk_bytes")
 
 # Move envelopes.  The caps are the measured/documented envelopes, not
 # arbitrary: prefetch's auto-resolution clamps at 16 (Config), a >16-deep
@@ -96,10 +107,10 @@ ALWAYS_FULL_FRAC = 0.9
 
 #: Data-health verdicts whose knob is outside the tuned set: noted in the
 #: trail, never moved on (verdict -> the knob that actually owns it).
+#: skew-hot left this set in ISSUE 11: the combiner knob now answers it.
 _FOREIGN_DATA_KNOBS = {
     "spill-bound": "--compact-slots",
     "rescue-heavy": "--max-token-bytes / the rescue budgets",
-    "skew-hot": "--merge-strategy (key-range partitioning load-imbalances)",
 }
 
 
@@ -108,7 +119,8 @@ def default_knobs() -> dict:
     return {"inflight_groups": DEFAULT_CONFIG.inflight_groups,
             "prefetch_depth": DEFAULT_CONFIG.resolved_prefetch_depth,
             "superstep": DEFAULT_CONFIG.superstep,
-            "chunk_bytes": DEFAULT_CONFIG.chunk_bytes}
+            "chunk_bytes": DEFAULT_CONFIG.chunk_bytes,
+            "combiner": DEFAULT_CONFIG.combiner}
 
 
 def validate_knobs(knobs: dict, backend: str = "auto") -> None:
@@ -122,6 +134,7 @@ def validate_knobs(knobs: dict, backend: str = "auto") -> None:
            superstep=int(knobs["superstep"]),
            inflight_groups=int(knobs["inflight_groups"]),
            prefetch_depth=int(knobs["prefetch_depth"]),
+           combiner=str(knobs.get("combiner", "off")),
            backend=backend)
 
 
@@ -193,6 +206,9 @@ def derive_signals(records: Iterable[dict],
         v = _num((pipeline or {}).get(key))
         if v is not None:
             config[key] = int(v)
+    combiner = (start or {}).get("combiner")
+    if isinstance(combiner, str):
+        config["combiner"] = combiner
 
     art = timeline.reconstruct(recs, run_id=chosen)
     bottleneck = art["bottleneck"] if art else None
@@ -254,7 +270,8 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
     cur = default_knobs()
     cur.update({k: v for k, v in sig["config"].items() if k in cur})
     if current:
-        cur.update({k: int(v) for k, v in current.items() if k in cur})
+        cur.update({k: (int(v) if k in _INT_KNOBS else str(v))
+                    for k, v in current.items() if k in cur})
 
     trail: List[dict] = []
 
@@ -267,7 +284,7 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
         prop = dict(cur)
         changed = {}
         for k, v in (changes or {}).items():
-            v = int(v)
+            v = int(v) if k in _INT_KNOBS else str(v)
             if v != cur[k]:
                 changed[k] = [cur[k], v]
                 prop[k] = v
@@ -302,7 +319,26 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
         return result("no-signal", "no telemetry to tune from",
                       converged=True)
 
-    # 2-3. Data-shape rules outrank pipeline rules: a wrong chunk geometry
+    # 2. Skew-hot data (ISSUE 11): the map-side combiner is the knob that
+    #    actually answers a Zipf-hot stream — enable it before any
+    #    pipeline knob moves (collapsed duplicates change every downstream
+    #    signal).  Already-on runs note the fact and fall through: the
+    #    remaining skew cost is the sort's to carry.
+    if consider("enable-combiner",
+                verdict == "skew-hot" and cur["combiner"] == "off",
+                f"data verdict {verdict!r}; combiner {cur['combiner']!r}"):
+        return result("enable-combiner",
+                      "one key carries a double-digit share of the stream "
+                      "(skew-hot): enable the map-side hot-key combiner so "
+                      "the dominant duplicates collapse in VMEM before the "
+                      "aggregation sort sees them",
+                      {"combiner": "hot-cache"})
+    if verdict == "skew-hot" and cur["combiner"] != "off":
+        consider("enable-combiner", False,
+                 f"data verdict {verdict!r} but combiner already "
+                 f"{cur['combiner']!r} — pipeline rules proceed")
+
+    # 3-4. Data-shape rules outrank pipeline rules: a wrong chunk geometry
     #    poisons every overlap signal downstream of it.
     if consider("grow-chunk",
                 verdict == "occupancy-starved"
@@ -429,7 +465,8 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
 # -- the search loop ---------------------------------------------------------
 
 def _key(knobs: dict):
-    return tuple(int(knobs[k]) for k in KNOBS)
+    return tuple(int(knobs[k]) if k in _INT_KNOBS else str(knobs.get(k))
+                 for k in KNOBS)
 
 
 def search(measure: Callable[[dict], Iterable[dict]],
@@ -459,7 +496,8 @@ def search(measure: Callable[[dict], Iterable[dict]],
         raise ValueError(f"budget must be >= 1, got {budget}")
     cur = default_knobs()
     if start:
-        cur.update({k: int(v) for k, v in start.items() if k in cur})
+        cur.update({k: (int(v) if k in _INT_KNOBS else str(v))
+                    for k, v in start.items() if k in cur})
     validate_knobs(cur, backend)
     seen = {_key(cur)}
     trail: List[dict] = []

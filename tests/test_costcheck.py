@@ -349,6 +349,86 @@ def test_fusion_pass_respects_vmem_envelope(mesh8):
                for c in cands), cands
 
 
+# -- combiner-vs-off cost gate (ISSUE 11) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def combiner_ctx(mesh8):
+    job = models_mod.build_model("wordcount_combiner")
+    return acore.AnalysisContext(job, "wordcount_combiner", mesh=mesh8)
+
+
+def test_cost_gate_certifies_combiner_below_off(combiner_ctx):
+    """ISSUE 11 acceptance: the hot-key-combiner model prices strictly
+    below its combiner-off twin's checked-in baseline, the artifact
+    carries the gap, and the fused-vs-split gate stays out of the way
+    (the pair is exempt — its fused-ness is wordcount_fused's
+    certificate)."""
+    report = acore.run_pipeline(combiner_ctx, [CostPass()])
+    assert not report.errors, report.format_text()
+    art = report.artifacts["wordcount_combiner"]["cost"]
+    gap = art["combiner_vs_off"]
+    assert gap["off_model"] == "wordcount_nocombiner"
+    assert gap["combiner_effective_input_passes"] \
+        < gap["off_effective_input_passes"]
+    assert gap["passes_saved"] > 0
+    assert "fused_vs_split" not in art
+    assert any("combiner certified" in f.message for f in report.findings)
+
+
+def test_cost_gate_flags_combiner_that_stopped_winning(mesh8, tmp_path,
+                                                       combiner_ctx):
+    """An off baseline priced BELOW the combiner program = the cache
+    stopped deleting sort traffic: ERROR, and no gap is published."""
+    if "cost" not in combiner_ctx.artifacts:
+        acore.run_pipeline(combiner_ctx, [CostPass()])
+    passes = combiner_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = combiner_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_nocombiner.json").write_text(json.dumps(
+        {"model": "wordcount_nocombiner",
+         "effective_input_passes": passes / 2,
+         "traced_chunk_bytes": chunk}))
+    (tmp_path / "wordcount_combiner.json").write_text(json.dumps(
+        {"model": "wordcount_combiner",
+         "effective_input_passes": passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(combiner_ctx.job, "wordcount_combiner",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = combiner_ctx.engine_traces  # reuse the trace
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("NOT strictly below" in f.message for f in errs), \
+        report.format_text()
+    assert report.exit_code != 0
+
+
+def test_cost_gate_refuses_combiner_incomparable_geometry(mesh8, tmp_path,
+                                                          combiner_ctx):
+    """An off baseline priced at a different chunk cannot gate the
+    combiner model, and the incomparable gap must not be published."""
+    if "cost" not in combiner_ctx.artifacts:
+        acore.run_pipeline(combiner_ctx, [CostPass()])
+    passes = combiner_ctx.artifacts["cost"]["effective_input_passes"]
+    chunk = combiner_ctx.artifacts["cost"]["traced_chunk_bytes"]
+    (tmp_path / "wordcount_nocombiner.json").write_text(json.dumps(
+        {"model": "wordcount_nocombiner",
+         "effective_input_passes": passes * 2,
+         "traced_chunk_bytes": chunk * 2}))
+    (tmp_path / "wordcount_combiner.json").write_text(json.dumps(
+        {"model": "wordcount_combiner",
+         "effective_input_passes": passes,
+         "traced_chunk_bytes": chunk}))
+    ctx = acore.AnalysisContext(combiner_ctx.job, "wordcount_combiner",
+                                mesh=mesh8, baselines_dir=str(tmp_path))
+    ctx._engine_traces = combiner_ctx.engine_traces
+    report = acore.run_pipeline(ctx, [CostPass()])
+    errs = _errors(report, "hbm-cost")
+    assert any("not comparable" in f.message for f in errs), \
+        report.format_text()
+    assert "combiner_vs_off" not in \
+        report.artifacts["wordcount_combiner"]["cost"]
+
+
 # -- fused-vs-split cost gate (ISSUE 6) --------------------------------------
 
 

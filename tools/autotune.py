@@ -70,10 +70,19 @@ def _probe_config(knobs: dict):
     passes run."""
     from mapreduce_tpu.config import Config
 
+    combiner = str(knobs.get("combiner", "off"))
     return Config(chunk_bytes=int(knobs["chunk_bytes"]),
                   superstep=int(knobs["superstep"]),
                   inflight_groups=int(knobs["inflight_groups"]),
                   prefetch_depth=int(knobs["prefetch_depth"]),
+                  combiner=combiner,
+                  # The hot-key cache only exists on the fused map path
+                  # (resolved_combiner_slots is 0 elsewhere): a probe that
+                  # left map_impl at 'split' would re-measure the IDENTICAL
+                  # program while reporting the combiner engaged — the same
+                  # pairing benchwatch._tuned_env applies to the tuned rows.
+                  map_impl="fused" if combiner == "hot-cache"
+                  else Config.map_impl,
                   table_capacity=1 << 18,
                   batch_unique_capacity=1 << 16)
 
@@ -302,6 +311,7 @@ def selftest() -> int:
     conv = _read_fixture("tuner_converged")
     occ = _read_fixture("tuner_occupancy")
     table = _read_fixture("tuner_tablepressure")
+    skew = _read_fixture("tuner_skewhot")
 
     # Single-proposal rule checks against each fixture (the unit facts the
     # convergence walks below compose).
@@ -310,7 +320,8 @@ def selftest() -> int:
             (device, "try-superstep", {"superstep": [1, 2]}),
             (conv, "converged", {}),
             (occ, "grow-chunk", {"chunk_bytes": [2097152, 4194304]}),
-            (table, "shrink-chunk", {"chunk_bytes": [4194304, 2097152]})]:
+            (table, "shrink-chunk", {"chunk_bytes": [4194304, 2097152]}),
+            (skew, "enable-combiner", {"combiner": ["off", "hot-cache"]})]:
         p = engine.propose(recs)
         assert p["rule"] == rule, (rule, p["rule"])
         assert p["changed"] == changed, (rule, p["changed"])
@@ -333,12 +344,33 @@ def selftest() -> int:
                                    "prefetch_depth": 4}, budget=6)
     assert r["stopped"] == "converged", r["stopped"]
     assert r["winner"] == {"chunk_bytes": 1 << 25, "superstep": 1,
-                           "inflight_groups": 4, "prefetch_depth": 16}, \
+                           "inflight_groups": 4, "prefetch_depth": 16,
+                           "combiner": "off"}, \
         r["winner"]
     assert [p["rule"] for p in r["trail"]] == \
         ["raise-prefetch", "raise-prefetch", "converged"], \
         [p["rule"] for p in r["trail"]]
     assert [c["prefetch_depth"] for c in sim_calls] == [4, 8, 16]
+
+    # Skew-hot system (ISSUE 11): a Zipf-hot ledger flips the combiner on
+    # in ONE pass; the combiner-on ledger then measures device-bound with
+    # the window unsaturated -> converged.  The decision trail must show
+    # enable-combiner firing exactly once, and no pipeline knob may move
+    # while the data-shape rule is answering the skew.
+    def sim_skew(knobs):
+        return skew if knobs["combiner"] == "off" else conv
+
+    rs = engine.search(sim_skew, {"chunk_bytes": 1 << 21, "superstep": 1,
+                                  "inflight_groups": 4,
+                                  "prefetch_depth": 4}, budget=6)
+    assert rs["stopped"] == "converged", rs["stopped"]
+    assert rs["winner"]["combiner"] == "hot-cache", rs["winner"]
+    assert rs["winner"]["prefetch_depth"] == 4 \
+        and rs["winner"]["superstep"] == 1 \
+        and rs["winner"]["inflight_groups"] == 4, rs["winner"]
+    assert [p["rule"] for p in rs["trail"]] == \
+        ["enable-combiner", "converged"], [p["rule"] for p in rs["trail"]]
+    assert rs["trail"][0]["changed"] == {"combiner": ["off", "hot-cache"]}
 
     # Device-bound system (window always full): superstep 1 -> 2 -> 4,
     # inflight provably NEVER raised — the "stop raising inflight" rule.
@@ -368,7 +400,7 @@ def selftest() -> int:
     assert r3["stopped"] == "oscillation", r3["stopped"]
     assert r3["passes"] == 2 and r3["trail"][-1].get("oscillation"), r3
     # Every proposal the walks produced passes real Config validation.
-    for res in (r, r2, r3):
+    for res in (r, r2, r3, rs):
         for p in res["trail"]:
             engine.validate_knobs(p["proposal"])
 
@@ -405,6 +437,8 @@ def selftest() -> int:
     print("autotune selftest ok (reader walk -> prefetch 16 in "
           f"{r['passes']} passes, device walk -> superstep "
           f"{r2['winner']['superstep']} with inflight untouched, "
+          f"skew walk -> combiner {rs['winner']['combiner']} in "
+          f"{rs['passes']} passes, "
           f"oscillation stopped in {r3['passes']}, profiles + value-aware "
           "LAST_GOOD ok)")
     return 0

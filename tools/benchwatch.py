@@ -99,13 +99,20 @@ def _tuned_env(profile_path: str, env: dict, log) -> dict | None:
     log(f"tuned config [{key}]: {cfg} (stopped={entry.get('stopped')}, "
         f"{entry.get('passes')} passes, "
         f"{entry.get('measured_gbps')} GB/s in-search)")
-    return {**env,
-            "BENCH_CHUNK_MB": str(max(1, int(cfg.get("chunk_bytes",
-                                                     32 << 20)) >> 20)),
-            "BENCH_STREAM_SUPERSTEP": str(cfg.get("superstep", 4)),
-            "BENCH_INFLIGHT": str(cfg.get("inflight_groups", 4)),
-            "BENCH_PREFETCH_DEPTH": str(cfg.get("prefetch_depth", 4)),
-            "BENCH_TRACE": "1"}
+    tuned = {**env,
+             "BENCH_CHUNK_MB": str(max(1, int(cfg.get("chunk_bytes",
+                                                      32 << 20)) >> 20)),
+             "BENCH_STREAM_SUPERSTEP": str(cfg.get("superstep", 4)),
+             "BENCH_INFLIGHT": str(cfg.get("inflight_groups", 4)),
+             "BENCH_PREFETCH_DEPTH": str(cfg.get("prefetch_depth", 4)),
+             "BENCH_TRACE": "1"}
+    if cfg.get("combiner", "off") != "off":
+        # The ISSUE 11 enable-combiner rule fired during the search: the
+        # tuned row must measure exactly that config (combiner rides the
+        # fused map path).
+        tuned["BENCH_COMBINER"] = str(cfg["combiner"])
+        tuned["BENCH_MAP_IMPL"] = "fused"
+    return tuned
 
 
 def main() -> int:
@@ -182,6 +189,25 @@ def main() -> int:
                  {**ab, "BENCH_MAP_IMPL": "fused"}),
                 ("bench-zipf-split", [sys.executable, "bench.py"],
                  {**ab, "BENCH_MAP_IMPL": "split"}),
+                # ISSUE 11 map-side combiner A/B (BENCHMARKS.md round 11
+                # pre-registration): the hot-key cache on Zipf vs the
+                # same fused path without it, plus a uniform-corpus
+                # CONTROL row where the combiner must be ~neutral (no hot
+                # keys to absorb; the taller windows ride the exact spill
+                # fallback if natural density exceeds them).  Each row's
+                # ledger carries the combiner counters, trace, bottleneck
+                # and data-health verdicts, and its BENCH JSON the
+                # certified combiner_vs_off pricing — prediction and
+                # measurement in one capture.
+                ("bench-zipf-combiner", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_MAP_IMPL": "fused",
+                  "BENCH_COMBINER": "hot-cache", "BENCH_TRACE": "1"}),
+                ("bench-zipf-nocombiner", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_MAP_IMPL": "fused", "BENCH_TRACE": "1"}),
+                ("bench-uniform-combiner", [sys.executable, "bench.py"],
+                 {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "64",
+                  "BENCH_MAP_IMPL": "fused",
+                  "BENCH_COMBINER": "hot-cache", "BENCH_TRACE": "1"}),
                 # Regression A/B rows: the previous default (sort3) and the
                 # uncompacted path.  segmin's stream-sized associative_scan
                 # wedges the chip (3 observations, BENCHMARKS.md round 4) —

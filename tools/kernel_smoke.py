@@ -78,6 +78,17 @@ def main() -> int:
                                           sort_impl="radix_partition"),
         "stable2_radix": Config(backend="pallas", chunk_bytes=1 << 20,
                                 table_capacity=1 << 16, sort_impl="radix"),
+        # ISSUE 11 map-side combiner: the hot-key cache's Mosaic surface
+        # — four revisited (8, 128) output refs, axis-0 sublane
+        # reductions, masked one-hot selects — has never lowered on a
+        # real chip; smoke it before the bench-zipf-combiner rows spend
+        # a window on it.  'salt' exercises the de-salting re-reduce.
+        "fused_combiner": Config(backend="pallas", chunk_bytes=1 << 20,
+                                 table_capacity=1 << 16, map_impl="fused",
+                                 combiner="hot-cache"),
+        "fused_salt": Config(backend="pallas", chunk_bytes=1 << 20,
+                             table_capacity=1 << 16, map_impl="fused",
+                             combiner="salt"),
     }.items():
         try:
             r = wordcount.count_words(data, cfg)

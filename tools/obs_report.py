@@ -303,7 +303,7 @@ def analyze_run(records: list) -> dict:
     pipeline = end.get("pipeline") if end else None
     header = {k: start.get(k) for k in
               ("driver", "job", "devices", "chunk_bytes", "superstep",
-               "backend", "map_impl", "merge_strategy", "input",
+               "backend", "map_impl", "combiner", "merge_strategy", "input",
                "retry", "ledger_version")} if start else None
     classification = classify(phases)
     # Measured timeline (ISSUE 7): present only when the run carries
@@ -448,6 +448,25 @@ def render_run(a: dict, out) -> None:
         if d.get("window_occupancy") is not None:
             out.write(f", windows {100 * d['window_occupancy']:.0f}% full")
         out.write("\n")
+        # Map-side combiner line (ISSUE 11): resolved mode + what the
+        # hot-key cache actually bought this run.
+        mode = d.get("combiner") or (a["header"] or {}).get("combiner")
+        if (mode and mode != "off") or d.get("combiner_hits"):
+            out.write(f"  combiner: {mode or '?'}")
+            hits = d.get("combiner_hits")
+            if hits:
+                hr = d.get("combiner_hit_rate")
+                out.write(f" — {hits} hits"
+                          + (f" ({100 * hr:.2f}% of tokens)"
+                             if hr is not None else ""))
+                if d.get("combiner_rows_deleted") is not None:
+                    out.write(f", {d['combiner_rows_deleted']} sort rows "
+                              "deleted")
+                out.write(f", {d.get('combiner_flushes', 0)} flushes "
+                          f"({d.get('combiner_evicted', 0)} cold)")
+            elif mode == "hot-cache":
+                out.write(" — no hits (cache cold or fallback-dominated)")
+            out.write("\n")
     health = a.get("data_health")
     if health:
         out.write(f"  data health: {health['verdict']}\n")
@@ -548,10 +567,19 @@ def compare_runs(a: dict, b: dict) -> list:
         siga, sigb = ha.get("signals", {}), hb.get("signals", {})
         for k in ("top_mass", "fallback_frac", "overlong_frac",
                   "dropped_frac", "table_occupancy", "window_occupancy",
-                  "distinct_ratio"):
+                  "distinct_ratio", "combiner_hit_rate"):
             va, vb = siga.get(k), sigb.get(k)
             if va is not None or vb is not None:
                 num(k, va, vb, "{:.4f}")
+    da, db = a.get("data") or {}, b.get("data") or {}
+    ca, cb = da.get("combiner"), db.get("combiner")
+    if (ca and ca != "off") or (cb and cb != "off"):
+        # The combiner A/B row (ISSUE 11): which arm ran which mode, and
+        # the net sort rows each deleted — the benchwatch
+        # bench-zipf-combiner / -nocombiner readout.
+        text("combiner", ca, cb)
+        num("combiner_rows_deleted", da.get("combiner_rows_deleted"),
+            db.get("combiner_rows_deleted"), "{:.0f}")
     return rows
 
 
@@ -615,7 +643,7 @@ def selftest() -> int:
     ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 6, f"fixture holds six runs, got {len(runs)}"
+    assert len(runs) == 7, f"fixture holds seven runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -693,7 +721,24 @@ def selftest() -> int:
     assert tn["trail"], "decision trail must ride the record"
     assert g7["timeline"]["bottleneck"]["resource"] == "reader", \
         "the tune hint and the timeline verdict describe the same run"
-    # Run 6 in file order (ISSUE 8): a spill-heavy pallas run carrying
+    # Run 6 in file order (ISSUE 11): a ledger-v5 combiner-on fused run.
+    # Hand arithmetic: 42000 of 60000 tokens absorbed by the hot-key
+    # cache (hit rate 0.7), 2000 flush rows re-emitted -> 40000 sort rows
+    # deleted net, 150 cold entries; the top key at 12000/60000 = 20% is
+    # skew-hot, and the flag's detail must say the combiner is already
+    # absorbing the stream instead of recommending the knob.
+    h8 = runs[5]
+    assert h8["header"]["ledger_version"] == 5, h8["header"]
+    assert h8["header"]["combiner"] == "hot-cache", h8["header"]
+    assert h8["data"]["combiner"] == "hot-cache", h8["data"]
+    h8sig = h8["data_health"]["signals"]
+    assert h8sig["combiner_hit_rate"] == round(42000 / 60000, 6), h8sig
+    assert h8sig["combiner_rows_deleted"] == 42000 - 2000, h8sig
+    assert h8["data_health"]["verdict"] == "skew-hot", h8["data_health"]
+    h8flag = next(f for f in h8["data_health"]["flags"]
+                  if f["flag"] == "skew-hot")
+    assert "absorbing 70.0%" in h8flag["detail"], h8flag
+    # Run 7 in file order (ISSUE 8): a spill-heavy pallas run carrying
     # per-group `data` dicts and the per-run `data` record.  Checked
     # against the arithmetic done by hand on the fixture: 3 of 6 chunks
     # took the full-resolution fallback (fallback_frac 0.5 > the 5%
@@ -702,7 +747,7 @@ def selftest() -> int:
     # the 5% gate), and 20 distinct keys spilled — so the verdict is
     # spill-bound with rescue-heavy and table-pressure riding along, and
     # nothing else.
-    e = runs[5]
+    e = runs[6]
     assert e["header"]["ledger_version"] == 3, e["header"]
     assert e["data"] is not None and e["data"]["fallback_chunks"] == 3
     eh = e["data_health"]
@@ -720,7 +765,7 @@ def selftest() -> int:
     egroups = [r for r in read_ledger(ledger)
                if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
     assert all("data" in g for g in egroups), egroups
-    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5)), \
+    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6)), \
         "runs without a tune record must carry None"
     # The clean A/B counterpart (mini_ledger_b): uniform corpus, no
     # fallbacks, top key at 24/60000 = 0.04% — verdict clean; the pair is
@@ -742,8 +787,11 @@ def selftest() -> int:
     render_run(d, buf)
     render_run(e, buf)
     render_run(g7, buf)
+    render_run(h8, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
+    assert ("combiner: hot-cache — 42000 hits (70.00% of tokens), "
+            "40000 sort rows deleted, 2000 flushes (150 cold)") in body, body
     assert "ANOMALY step-time spike" in body
     assert "ANOMALY memory growth" in body
     assert "injected device fault" in body

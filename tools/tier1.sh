@@ -13,11 +13,12 @@
 # fused-vs-split gate: wordcount_fused must price strictly below the
 # split baseline + the ISSUE 8 telemetry gate: the instrumented
 # wordcount_telemetry twins must price within 1% of their uninstrumented
-# baselines), vmem-budget, kernel-race, fusion-opportunity (INFO
-# candidates; a crash or mis-severity would fail here) — plus the
-# production kernel-geometry certification (fused seam-aux geometry
-# included).  Any error-severity finding fails tier-1 before a single
-# test runs.
+# baselines + the ISSUE 11 combiner gate: wordcount_combiner must price
+# strictly below its combiner-off twin), vmem-budget, kernel-race,
+# fusion-opportunity (INFO candidates; a crash or mis-severity would
+# fail here) — plus the production kernel-geometry certification (fused
+# seam-aux and hot-key-combiner geometries included).  Any
+# error-severity finding fails tier-1 before a single test runs.
 cd "$(dirname "$0")/.." || exit 1
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-models --min-severity error || { echo "TIER1: costcheck gate FAILED"; exit 1; }
 # Jax-free reporting-path gates (ISSUE 7/8 satellites): the obs_report
