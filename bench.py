@@ -31,7 +31,8 @@ step size, default 32 — the measured sweet spot on v5e), BENCH_REPEATS
 (device passes over the resident corpus in the timed dispatch, default 8),
 BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
-BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_COMBINER / BENCH_MERGE_EVERY /
+BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_COMBINER / BENCH_GEOMETRY /
+BENCH_MERGE_EVERY /
 BENCH_COMPACT_SLOTS /
 BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
 so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
@@ -553,6 +554,12 @@ def main() -> int:
     # BENCH_COMBINER A/Bs the ISSUE 11 map-side combiner (hot-cache /
     # salt; pairs with BENCH_MAP_IMPL=fused) — measurement-altering, so
     # LAST_GOOD's class-based knob gate refuses it like every other A/B.
+    # BENCH_GEOMETRY A/Bs a searched kernel-geometry set (ISSUE 12): a
+    # preset name, or a JSON field dict for non-preset shortlist winners
+    # — measurement-altering, refused by the same class gate.
+    geom_env = os.environ.get("BENCH_GEOMETRY") or None
+    if geom_env and geom_env.lstrip().startswith("{"):
+        geom_env = json.loads(geom_env)
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("BENCH_SORT_MODE",
@@ -563,6 +570,7 @@ def main() -> int:
                                          Config.map_impl),
                  combiner=os.environ.get("BENCH_COMBINER",
                                          Config.combiner),
+                 geometry=geom_env,
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ

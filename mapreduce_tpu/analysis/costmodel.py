@@ -229,15 +229,8 @@ def find_aggregation_sort(jaxpr, num_keys: int | None = None
     return best
 
 
-def stable2_sort_rows(chunk_bytes: int, block_rows: int, slots: int,
-                      lanes: int = 128) -> int:
-    """Rows of the stable2 aggregation sort for a pallas chunk, from the
-    kernel geometry alone: the lane-major column pass emits ``slots``
-    output rows per ``block_rows``-byte window per lane, over the padded
-    column view (one extra pad block; the seam stream aggregates
-    separately on this path).  Must match the traced sort equation exactly
-    — the static leg of the round-6 pricing cross-check."""
-    seg_len = chunk_bytes // lanes
-    pad_rows = (-seg_len) % block_rows + block_rows
-    grid = (seg_len + pad_rows) // block_rows
-    return grid * slots * lanes
+# The canonical sort-row formula moved to analysis/geometry.py (ISSUE 12:
+# the jax-free geometry search prices CANDIDATE geometries with the same
+# arithmetic the cost pass asserts against the traced sort equation —
+# one formula, re-exported here for the pass's historical import path).
+from mapreduce_tpu.analysis.geometry import stable2_sort_rows  # noqa: E402,F401

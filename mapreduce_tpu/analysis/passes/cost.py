@@ -105,6 +105,13 @@ class CostPass:
         out: list[core.Finding] = []
         chunk_bytes = trace._chunk_bytes_for(ctx.job)
         report: dict = {"traced_chunk_bytes": chunk_bytes, "programs": {}}
+        config = getattr(ctx.job, "config", None)
+        if config is not None and hasattr(config, "geometry_label"):
+            # Which kernel-geometry set priced this report (ISSUE 12):
+            # candidate geometries are first-class here — every derived
+            # figure below re-reads the CANDIDATE's resolved values, not
+            # the shipped constants.
+            report["geometry"] = config.geometry_label
 
         step_cost = None
         for hook, traced in ctx.engine_traces.items():
@@ -248,6 +255,29 @@ class CostPass:
                 hint="the sort pricing formula no longer matches the "
                      "program; fix costmodel.stable2_sort_rows or the "
                      "kernel, then re-measure")]
+        # A non-default Config.geometry (ISSUE 12): the STATIC leg above
+        # already certified the candidate's row arithmetic against the
+        # traced program (expected was derived from the candidate's own
+        # resolved_block_rows/slots), but the measured sort-milliseconds
+        # fixture describes the SHIPPED 384-row geometry — extrapolating
+        # it over a different window would manufacture a phantom pricing
+        # drift, the combiner-512 lesson.  The candidate's modeled delta
+        # lives in the geometry search artifact; the probe pass measures.
+        from mapreduce_tpu.config import DEFAULT_GEOMETRY
+
+        if config.resolved_geometry != DEFAULT_GEOMETRY:
+            art["measured_leg"] = "skipped: non-default geometry " \
+                f"({config.geometry_label}); rates fixture describes the " \
+                "shipped default"
+            return [core.Finding(
+                severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+                hook="step",
+                message=(f"candidate geometry {config.geometry_label!r}: "
+                         f"sort rows {sort.rows} certified against the "
+                         "candidate's own window arithmetic; measured-rate "
+                         "cross-check pinned to the shipped default "
+                         "geometry (probe passes own the measurement)"),
+                location=sort.location)]
         # Static extrapolation to the measured production geometry, then
         # the measured-rate leg: passes = sort_ms / one-pass ms.
         prod_rows = costmodel.stable2_sort_rows(

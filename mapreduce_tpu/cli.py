@@ -187,6 +187,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--combiner-slots", type=int, default=None, metavar="C",
                    help="per-lane hot-key cache entries for --combiner "
                         "hot-cache (multiple of 8 in [8, 32]; default 8)")
+    p.add_argument("--geometry", default=None, metavar="G",
+                   help="kernel-geometry set (ISSUE 12): a preset name "
+                        "('tall512', 'combiner16'), 'auto' to resolve "
+                        "from the geometry search's tuned profile "
+                        "(--geometry-profile), or omit for the shipped "
+                        "default constants.  Results are bit-identical "
+                        "across certified geometries; only the cost "
+                        "moves")
+    p.add_argument("--geometry-profile", default="tuned.json",
+                   metavar="PATH",
+                   help="tuned.json searched profiles for --geometry "
+                        "auto (default ./tuned.json; missing file "
+                        "resolves to the default geometry)")
     p.add_argument("--max-token-bytes", type=int, default=32, metavar="W",
                    help="pallas backend: tokens longer than W bytes are "
                         "dropped into dropped_* accounting (xla counts any "
@@ -542,12 +555,29 @@ def main(argv: list[str] | None = None) -> int:
                         compact_slots=args.compact_slots,
                         combiner=args.combiner,
                         combiner_slots=args.combiner_slots,
+                        geometry=args.geometry,
                         rescue_overlong=args.rescue_overlong,
                         rescue_overlong_max=args.rescue_overlong_max,
                         rescue_window=args.rescue_window,
                         autotune="hint" if args.autotune else "off")
     except ValueError as e:
         parser.error(str(e))
+
+    if args.geometry == "auto":
+        # Resolve 'auto' BEFORE any trace, against the geometry search's
+        # tuned profiles (the combiner='auto' discipline: resolution is
+        # the driver's job; the resolved set is stamped into this run's
+        # records via the run_start geometry label).
+        import dataclasses as _dc
+
+        from mapreduce_tpu.analysis.geometry import resolve_auto
+
+        resolved_geom = resolve_auto(args.geometry_profile)
+        config = _dc.replace(
+            config, geometry=None if resolved_geom == "default"
+            else resolved_geom)
+        print(f"geometry: auto -> {config.geometry_label}",
+              file=sys.stderr)
 
     if args.combiner == "auto":
         # Resolve 'auto' BEFORE any trace, against the prior run's records
@@ -701,11 +731,14 @@ def _batch_run_start(tel, job: str, paths, config, input_bytes: int) -> None:
     run_start, a result-derived `data` record, and run_end — enough for
     obs_report/--compare, and a crash leaves the honest run_start-only
     trail."""
+    from mapreduce_tpu.runtime.executor import _geometry_stamp
+
     tel.ledger_write("run_start", driver="single_buffer", job=job,
                      devices=1, chunk_bytes=input_bytes,
                      superstep=1, backend=_resolved_backend_name(config),
                      map_impl=config.map_impl,
                      combiner=config.resolved_combiner,
+                     **_geometry_stamp(config),
                      merge_strategy="none", input=list(paths),
                      resume_step=0, resume_offset=0, retry=0)
 

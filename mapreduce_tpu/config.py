@@ -34,6 +34,157 @@ SEGMIN_TPU_ERROR = (
 COMBINER_SALT_BITS = 3
 
 
+def radix_slab_cap(bits: int, block_rows: int, slab_slack: int) -> int:
+    """Resolved radix slab rows per (block, lane, bucket): the slack
+    multiple of the uniform share, clamped to the block — the ONE owner
+    of the clamp (Geometry validation, the meta plan constructor, and
+    the kernel wrapper all call this, so the certifier can never
+    desynchronize from what the kernel binds)."""
+    return min(slab_slack * block_rows // (1 << bits), block_rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """One complete set of Pallas kernel geometries (ISSUE 12).
+
+    Every field was a hand-picked constant scattered across the kernel
+    wrappers until PR 12; collecting them in one validated, hashable
+    dataclass is what makes the geometries *searchable*: the jax-free
+    enumerator (``mapreduce_tpu/analysis/geometry.py``) walks candidate
+    values over the tile-aligned lattice, the vmem/cost certifiers price
+    and gate each candidate, and ``Config.geometry`` threads a certified
+    winner to every kernel call site and ``vmem_plan`` metadata hook.
+
+    The defaults ARE the shipped constants — a default ``Geometry()``
+    reproduces today's kernels bit-for-bit (tested against the checked-in
+    ``production_plans`` footprints).  Validation mirrors the kernel
+    wrappers' envelopes so an off-lattice candidate fails at construction,
+    not mid-trace; the *budget* gate (can the footprint fit VMEM?) is
+    deliberately NOT here — that is the certifier's job, and the bounds
+    below only encode tile alignment and packing-format limits.
+    """
+
+    #: stable2 lane-major compact window height in byte rows.  Multiple of
+    #: 128: the fused path's raw lane-view input block is (LANES,
+    #: block_rows) and Mosaic needs the minor block dim 128-divisible.
+    block_rows: int = 384
+    #: Slots per stable2 window.  Pinned to 128 — the only chip-validated
+    #: lane-major value (the transposed output block puts SLOTS in the
+    #: 128-divisible minor dim; S=120 failed lowering, BENCHMARKS r4).
+    compact_slots: int = 128
+    #: sort3 compact window height / slot budget (the round-4 shipped
+    #: 256/88: 88 covers every measured density at 256 rows).
+    sort3_block_rows: int = 256
+    sort3_slots: int = 88
+    #: Pair-resolution (spill-fallback / full-resolution) window height.
+    pair_block_rows: int = 256
+    #: Window height when the hot-key combiner runs (the cache absorbs
+    #: the dominant duplicates, paying for taller windows — PR 11).
+    combiner_block_rows: int = 512
+    #: Per-lane hot-key cache entries (whole (8, 128) tiles).
+    combiner_slots: int = 8
+    #: Fused seam-carry aux plane rows (uint8 tile grid: multiple of 32;
+    #: the head row is pinned at 64 = the W <= 63 bound, so 96 is the
+    #: smallest tile-aligned plane that holds it).
+    aux_rows: int = 96
+    #: Radix partition digit width (B = 2**bits buckets per level).
+    radix_bits: int = 3
+    radix_block_rows: int = 256
+    #: Slab budget per (block, lane, bucket) as a multiple of the uniform
+    #: share block_rows/B — the write-amplification factor of the round-6
+    #: pricing note, now a searchable knob.
+    radix_slab_slack: int = 4
+
+    def __post_init__(self) -> None:
+        for name in ("block_rows", "combiner_block_rows", "pair_block_rows"):
+            v = getattr(self, name)
+            if v % 128 or not 128 <= v <= (1 << 20):
+                raise ValueError(
+                    f"{name} must be a multiple of 128 in [128, 2**20] "
+                    f"(the fused lane-view block puts rows in the "
+                    f"128-divisible minor dim), got {v}")
+        if self.compact_slots != 128:
+            raise ValueError(
+                "compact_slots must be 128 (the only chip-validated "
+                "lane-major slot count: the transposed output block puts "
+                f"slots in the 128-divisible minor dim), got "
+                f"{self.compact_slots}")
+        if self.block_rows < 2 * self.compact_slots:
+            raise ValueError(
+                f"block_rows {self.block_rows} must be >= 2 * "
+                f"compact_slots ({2 * self.compact_slots}): the kernel's "
+                "pairwise fold emits at most block_rows/2 live rows")
+        if self.combiner_block_rows < 2 * self.compact_slots:
+            raise ValueError(
+                f"combiner_block_rows {self.combiner_block_rows} must be "
+                f">= 2 * compact_slots ({2 * self.compact_slots})")
+        if self.sort3_block_rows % 32 \
+                or not 64 <= self.sort3_block_rows <= (1 << 20):
+            raise ValueError(
+                f"sort3_block_rows must be a multiple of 32 in "
+                f"[64, 2**20] (uint8 sublane tile), got "
+                f"{self.sort3_block_rows}")
+        if self.sort3_slots % 8 \
+                or not 8 <= self.sort3_slots <= self.sort3_block_rows // 2:
+            raise ValueError(
+                f"sort3_slots must be a multiple of 8 in [8, "
+                f"sort3_block_rows/2={self.sort3_block_rows // 2}], got "
+                f"{self.sort3_slots}")
+        if self.combiner_slots % 8 or not 8 <= self.combiner_slots <= 32:
+            raise ValueError(
+                f"combiner_slots must be a multiple of 8 in [8, 32], got "
+                f"{self.combiner_slots}")
+        if self.aux_rows % 32 or not 96 <= self.aux_rows <= 512:
+            raise ValueError(
+                f"aux_rows must be a multiple of 32 in [96, 512] (the "
+                "pinned head row at 64 needs the plane past it), got "
+                f"{self.aux_rows}")
+        if not 1 <= self.radix_bits <= 5:
+            raise ValueError(
+                f"radix_bits must be in [1, 5] (B output-ref triples are "
+                f"unrolled in the kernel), got {self.radix_bits}")
+        if self.radix_block_rows % 8 \
+                or not 64 <= self.radix_block_rows <= (1 << 20):
+            raise ValueError(
+                f"radix_block_rows must be a multiple of 8 in [64, 2**20], "
+                f"got {self.radix_block_rows}")
+        if self.radix_slab_slack < 1:
+            raise ValueError(
+                f"radix_slab_slack must be >= 1, got {self.radix_slab_slack}")
+        cap = radix_slab_cap(self.radix_bits, self.radix_block_rows,
+                             self.radix_slab_slack)
+        if cap < 8 or cap % 8:
+            raise ValueError(
+                f"radix slab cap {cap} (= slack*block_rows/B, clamped to "
+                "block_rows) must be a multiple of 8 and >= 8; adjust "
+                "radix_block_rows/radix_bits/radix_slab_slack")
+
+    @property
+    def radix_cap(self) -> int:
+        """Resolved per-(block, lane, bucket) slab rows (:func:`radix_slab_cap`)."""
+        return radix_slab_cap(self.radix_bits, self.radix_block_rows,
+                              self.radix_slab_slack)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+DEFAULT_GEOMETRY = Geometry()
+
+#: Named geometry presets: the certified profiles a string
+#: ``Config.geometry`` (and the tuner's ``geometry`` knob) can name.
+#: 'tall512' is the PR-11 measured pair's other arm — 512-row windows at
+#: the same 128 slots WITHOUT the combiner: −25% stable2 sort rows per
+#: 32 MB chunk, at a spill risk on dense corpora the exact fallback
+#: absorbs (the round-11 dead-end branch, now probe-able instead of
+#: hand-written).  'combiner16' doubles the hot-key cache depth.
+GEOMETRY_PRESETS = {
+    "default": DEFAULT_GEOMETRY,
+    "tall512": Geometry(block_rows=512),
+    "combiner16": Geometry(combiner_slots=16),
+}
+
+
 def segmin_allowed() -> bool:
     """Single owner of the MAPREDUCE_ALLOW_SEGMIN override parse: the raw
     string truthiness trap ('0' would bypass the wedge guard) is avoided by
@@ -298,6 +449,25 @@ class Config:
     # handful of keys carries the collapsible mass (PR 8's top_mass
     # proxy measures exactly this).
     combiner_slots: Optional[int] = None
+    # Kernel-geometry override (ISSUE 12): which certified set of Pallas
+    # kernel geometries this run compiles.  None (default) = the shipped
+    # constants (``DEFAULT_GEOMETRY`` — today's kernels bit-for-bit).  A
+    # ``Geometry`` instance or a plain dict of its fields (validated and
+    # frozen at construction) = an explicit candidate, e.g. one the
+    # geometry search shortlisted (tools/geomsearch.py); a preset name
+    # from ``GEOMETRY_PRESETS`` ('tall512', 'combiner16', ...) = the same
+    # by name, which is how the autotuner's geometry knob round-trips
+    # through ledgers and tuned.json.  'auto' = resolve from a searched
+    # profile BEFORE compiling — the driver's job, like combiner='auto'
+    # (the CLI resolves against tuned.json via
+    # analysis/geometry.resolve_auto; an unresolved 'auto' behaves as
+    # the default).  Results are BIT-IDENTICAL across certified
+    # geometries (the emission set, fallback exactness and accounting
+    # are geometry-independent — tested); only the cost moves, which is
+    # the point.  Scope: the pallas kernel paths (wordcount family +
+    # grams + the radix sort seam); the xla backend has no kernel
+    # geometry and ignores it.
+    geometry: object = None
     # Second-tier rescue budget (VERDICT r4 weak #4): URL-heavy text carries
     # ~15K overlong occurrences per 32 MB chunk (tools/overlong.py) — far
     # past the 1024-slot primary budget, which silently left >90% of them
@@ -400,6 +570,24 @@ class Config:
                 raise ValueError(
                     "combiner_slots sizes the hot-key cache; set "
                     "combiner='hot-cache' (or 'auto') to use it")
+        if isinstance(self.geometry, dict):
+            # Accept plain dicts (JSON-shaped candidates from tuned.json /
+            # the search tools) but STORE the validated frozen dataclass:
+            # Config is hashable (a static jit argument), so the field
+            # must be too.
+            object.__setattr__(self, "geometry", Geometry(**self.geometry))
+        if isinstance(self.geometry, str):
+            if self.geometry != "auto" \
+                    and self.geometry not in GEOMETRY_PRESETS:
+                raise ValueError(
+                    f"unknown geometry {self.geometry!r} (expected 'auto', "
+                    f"a preset name {sorted(GEOMETRY_PRESETS)}, a Geometry, "
+                    "or a dict of its fields)")
+        elif self.geometry is not None \
+                and not isinstance(self.geometry, Geometry):
+            raise ValueError(
+                f"geometry must be None, 'auto', a preset name, a Geometry "
+                f"or a dict, got {type(self.geometry).__name__}")
         if self.autotune not in ("off", "hint"):
             raise ValueError(f"unknown autotune mode {self.autotune!r} "
                              "(expected 'off' or 'hint')")
@@ -452,13 +640,43 @@ class Config:
         return max(min(self.chunk_bytes >> 10, 1 << 16), self.rescue_slots)
 
     @property
+    def resolved_geometry(self) -> Geometry:
+        """The :class:`Geometry` this config compiles (see ``geometry``).
+        An unresolved 'auto' behaves as the default — resolution against a
+        searched profile is the driver's job (CLI / tools), never the
+        trace's (the combiner='auto' contract)."""
+        g = self.geometry
+        if g is None or g == "auto":
+            return DEFAULT_GEOMETRY
+        if isinstance(g, str):
+            return GEOMETRY_PRESETS[g]
+        return g
+
+    @property
+    def geometry_label(self) -> str:
+        """Compact name for ledgers / tuned profiles: 'default', a preset
+        name, or 'custom' for an explicit non-preset Geometry."""
+        g = self.geometry
+        if g is None or g == "auto":
+            return "default"
+        if isinstance(g, str):
+            return g
+        if g == DEFAULT_GEOMETRY:
+            return "default"
+        return "custom"
+
+    @property
     def resolved_compact_slots(self) -> int:
         """The resolved slot-compaction budget (see ``compact_slots``):
         88 per 256-byte window, or 128 per 384-byte window under stable2's
-        lane-major geometry (both measured spill-free, tools/density.py)."""
+        lane-major geometry (both measured spill-free, tools/density.py).
+        An explicit ``compact_slots`` wins over the geometry's value (the
+        legacy knob precedence)."""
         if self.compact_slots is not None:
             return self.compact_slots
-        return 128 if self.sort_mode == "stable2" else 88
+        g = self.resolved_geometry
+        return g.compact_slots if self.sort_mode == "stable2" \
+            else g.sort3_slots
 
     @property
     def resolved_combiner(self) -> str:
@@ -472,11 +690,13 @@ class Config:
     def resolved_combiner_slots(self) -> int:
         """Per-lane hot-key cache entries (0 = no cache).  Nonzero only
         where the cache exists: the fused pallas compact path under
-        combiner='hot-cache'."""
+        combiner='hot-cache'.  An explicit ``combiner_slots`` wins over
+        the geometry's value (the legacy knob precedence)."""
         if self.resolved_combiner != "hot-cache" or self.map_impl != "fused" \
                 or not self.resolved_compact_slots:
             return 0
-        return self.combiner_slots if self.combiner_slots is not None else 8
+        return self.combiner_slots if self.combiner_slots is not None \
+            else self.resolved_geometry.combiner_slots
 
     @property
     def resolved_salt_bits(self) -> int:
@@ -486,16 +706,54 @@ class Config:
 
     @property
     def resolved_block_rows(self) -> int | None:
-        """Kernel window height in byte rows: 384 under stable2 (so the
-        transposed output block is a tile-aligned (128, 128) store), 512
-        when the hot-key combiner runs (the cache absorbs the dominant
-        duplicates, so taller windows — ~25% fewer sort rows per chunk —
-        stay within the same 128-slot budget; denser windows keep the
-        exact spill fallback), else the kernel's own default (None ->
-        256)."""
+        """Compact-kernel window height in byte rows, from the resolved
+        geometry: ``block_rows`` (default 384) under stable2 — the
+        transposed output block stays a tile-aligned (128, 128) store —
+        or ``combiner_block_rows`` (default 512) when the hot-key
+        combiner runs (the cache absorbs the dominant duplicates, so
+        taller windows — ~25% fewer sort rows per chunk — stay within
+        the same 128-slot budget; denser windows keep the exact spill
+        fallback).  Under sort3 the geometry's ``sort3_block_rows``
+        applies; None (the default 256 there) defers to the kernel's own
+        default so geometry-free callers stay byte-identical."""
+        g = self.resolved_geometry
         if self.sort_mode != "stable2":
+            return g.sort3_block_rows \
+                if g.sort3_block_rows != DEFAULT_GEOMETRY.sort3_block_rows \
+                else None
+        return g.combiner_block_rows if self.resolved_combiner_slots \
+            else g.block_rows
+
+    @property
+    def resolved_pair_block_rows(self) -> int | None:
+        """Pair-resolution (full-resolution / spill-fallback) window
+        height; None defers to the kernel's default (256) so the default
+        geometry traces the exact pre-ISSUE-12 programs."""
+        g = self.resolved_geometry
+        return g.pair_block_rows \
+            if g.pair_block_rows != DEFAULT_GEOMETRY.pair_block_rows \
+            else None
+
+    @property
+    def resolved_aux_rows(self) -> int | None:
+        """Fused seam-carry plane rows; None defers to the kernel's
+        AUX_ROWS default (96)."""
+        g = self.resolved_geometry
+        return g.aux_rows \
+            if g.aux_rows != DEFAULT_GEOMETRY.aux_rows else None
+
+    @property
+    def resolved_radix_geometry(self) -> tuple | None:
+        """(bits, block_rows, slab_slack) for the radix sort seam, or
+        None for the module defaults — the None-sentinel keeps the
+        radix wrapper's call-time default resolution (tests shrink the
+        module geometry globally) intact on default configs."""
+        g = self.resolved_geometry
+        d = DEFAULT_GEOMETRY
+        if (g.radix_bits, g.radix_block_rows, g.radix_slab_slack) == \
+                (d.radix_bits, d.radix_block_rows, d.radix_slab_slack):
             return None
-        return 512 if self.resolved_combiner_slots else 384
+        return (g.radix_bits, g.radix_block_rows, g.radix_slab_slack)
 
     @property
     def resolved_prefetch_depth(self) -> int:

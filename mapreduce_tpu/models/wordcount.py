@@ -284,7 +284,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 max_pos=int(chunk.shape[0]), sort_mode=mode,
                 rescue_slots=config.rescue_slots_max,
                 sort_impl=config.sort_impl,
-                salt_bits=config.resolved_salt_bits)
+                salt_bits=config.resolved_salt_bits,
+                radix_geometry=config.resolved_radix_geometry)
             if not config.rescue_slots:
                 res = ret(accounted(built, overlong), zero_u32)
             else:
@@ -325,7 +326,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 max_pos=int(chunk.shape[0]), sort_mode="stable2",
                 rescue_slots=config.rescue_slots_max,
                 sort_impl=config.sort_impl,
-                salt_bits=config.resolved_salt_bits)
+                salt_bits=config.resolved_salt_bits,
+                radix_geometry=config.resolved_radix_geometry)
             seam_tbl = table_ops.from_stream(
                 seam,
                 min(capacity,
@@ -386,7 +388,8 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
             scalar (the non-compact entry's stats need it; the cond
             branch below drops it)."""
             col, seam, overlong = pallas_tok.tokenize_split(
-                chunk, max_token_bytes=config.pallas_max_token)
+                chunk, max_token_bytes=config.pallas_max_token,
+                block_rows=config.resolved_pair_block_rows)
             return seamed_ret(aggregate(col, seam, overlong)), overlong
 
         def full_path(_):
@@ -398,7 +401,9 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                 # (full resolution, exact).  Pair-layout streams interleave
                 # lanes, so first occurrence needs the third sort key.
                 stream, overlong, _sp = pallas_tok.tokenize_fused(
-                    chunk, max_token_bytes=config.pallas_max_token)
+                    chunk, max_token_bytes=config.pallas_max_token,
+                    block_rows=config.resolved_pair_block_rows,
+                    aux_rows=config.resolved_aux_rows)
                 return seamed_ret(aggregate_stream(stream, overlong,
                                                    concat_sort_mode)), \
                     overlong
@@ -422,13 +427,15 @@ def _map_stream(chunk: jax.Array, config: Config, capacity: int,
                     chunk, compact_slots=config.resolved_compact_slots,
                     max_token_bytes=config.pallas_max_token,
                     block_rows=config.resolved_block_rows,
-                    lane_major=lane_major, combiner_slots=combiner_slots)
+                    lane_major=lane_major, combiner_slots=combiner_slots,
+                    aux_rows=config.resolved_aux_rows)
             else:
                 stream, overlong, spill = pallas_tok.tokenize_fused(
                     chunk, compact_slots=config.resolved_compact_slots,
                     max_token_bytes=config.pallas_max_token,
                     block_rows=config.resolved_block_rows,
-                    lane_major=lane_major)
+                    lane_major=lane_major,
+                    aux_rows=config.resolved_aux_rows)
                 cache = None
             # Lane-major fused streams stay in global byte-position order
             # (cross-seam tokens land in their start-position slot), so the
@@ -557,7 +564,8 @@ def _ngram_step(data: jax.Array, capacity: int, n: int,
     return ngram_ops.gram_table(gs, capacity, 0, max_pos=data.shape[0],
                                 sort_mode=config.sort_mode,
                                 sort_impl=config.sort_impl,
-                                salt_bits=config.resolved_salt_bits)
+                                salt_bits=config.resolved_salt_bits,
+                                radix_geometry=config.resolved_radix_geometry)
 
 
 def count_ngrams(data: bytes, n: int, config: Config = DEFAULT_CONFIG) -> WordCountResult:
@@ -861,11 +869,12 @@ class NGramCountJob(WordCountJob):
                                          chunk_id, self.config)
         gs = ngram_ops.mark_long_spans(
             tok_ops.ngrams(tok_ops.tokenize(chunk), self.n))
-        return ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
-                                    max_pos=chunk.shape[0],
-                                    sort_mode=self.config.sort_mode,
-                                    sort_impl=self.config.sort_impl,
-                                    salt_bits=self.config.resolved_salt_bits)
+        return ngram_ops.gram_table(
+            gs, self.batch_capacity, chunk_id, max_pos=chunk.shape[0],
+            sort_mode=self.config.sort_mode,
+            sort_impl=self.config.sort_impl,
+            salt_bits=self.config.resolved_salt_bits,
+            radix_geometry=self.config.resolved_radix_geometry)
 
     # -- exact cross-chunk grams (streamed runs) ----------------------------
 
@@ -892,11 +901,12 @@ class NGramCountJob(WordCountJob):
         else:
             stream = tok_ops.tokenize(chunk)
             gs = ngram_ops.mark_long_spans(tok_ops.ngrams(stream, self.n))
-            t = ngram_ops.gram_table(gs, self.batch_capacity, chunk_id,
-                                     max_pos=chunk.shape[0],
-                                     sort_mode=self.config.sort_mode,
-                                     sort_impl=self.config.sort_impl,
-                                     salt_bits=self.config.resolved_salt_bits)
+            t = ngram_ops.gram_table(
+                gs, self.batch_capacity, chunk_id, max_pos=chunk.shape[0],
+                sort_mode=self.config.sort_mode,
+                sort_impl=self.config.sort_impl,
+                salt_bits=self.config.resolved_salt_bits,
+                radix_geometry=self.config.resolved_radix_geometry)
             summ = ngram_ops.summary_from_stream(stream, chunk_id, self.n)
         gathered = jax.lax.all_gather(summ, axis_name=axis)  # leaves [D, n-1]
         return NGramUpdate(batch=t, summaries=gathered,

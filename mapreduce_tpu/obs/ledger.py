@@ -77,8 +77,12 @@ from typing import Iterator, Optional
 #: only); 5 = the ``data`` record and run_start gain the map-side
 #: combiner fields (ISSUE 11: ``combiner`` resolved mode,
 #: ``combiner_hits``/``combiner_flushes``/``combiner_evicted`` counters,
-#: ``combiner_hit_rate``/``combiner_rows_deleted`` derived ratios).
-LEDGER_VERSION = 5
+#: ``combiner_hit_rate``/``combiner_rows_deleted`` derived ratios);
+#: 6 = run_start gains the kernel-geometry stamp (ISSUE 12: ``geometry``
+#: label — 'default', a preset name, or 'custom' — plus
+#: ``geometry_spec`` with the full field dict on custom runs), the knob
+#: the geometry search tunes and ``obs_report --compare`` diffs.
+LEDGER_VERSION = 6
 
 
 class RunLedger:

@@ -142,7 +142,8 @@ def mark_long_spans(stream: TokenStream) -> TokenStream:
 def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
                max_pos: int, sort_mode: str = "stable2",
                sort_impl: str = "xla",
-               salt_bits: int = 0) -> table_ops.CountTable:
+               salt_bits: int = 0,
+               radix_geometry: tuple | None = None) -> table_ops.CountTable:
     """Aggregate a position-ordered gram stream into a count table.
 
     Both backends' gram streams arrive in ascending start-position order
@@ -196,7 +197,7 @@ def gram_table(gs: TokenStream, capacity: int, pos_hi: jax.Array | int,
     t = table_ops.from_packed_rows(
         gs.key_hi, gs.key_lo, packed, jnp.sum(gs.count), capacity, pos_hi,
         len_bits=7, sort_mode=sort_mode, sort_impl=sort_impl,
-        salt_bits=salt_bits)
+        salt_bits=salt_bits, radix_geometry=radix_geometry)
     occ = t.occupied()
     return t._replace(length=jnp.where(
         occ & (t.length == jnp.uint32(127)),
@@ -235,16 +236,20 @@ def ngram_map_with_summary(chunk: jax.Array, n: int, capacity: int,
         # pair path is spill-free by construction (exactness without a
         # fallback cond).  Poison rows ride the same stream.
         stream, overlong, _spill = pallas_tok.tokenize_fused(
-            chunk, max_token_bytes=config.pallas_max_token)
+            chunk, max_token_bytes=config.pallas_max_token,
+            block_rows=config.resolved_pair_block_rows,
+            aux_rows=config.resolved_aux_rows)
     else:
         col, seam, overlong = pallas_tok.tokenize_split(
-            chunk, max_token_bytes=config.pallas_max_token)
+            chunk, max_token_bytes=config.pallas_max_token,
+            block_rows=config.resolved_pair_block_rows)
         stream = pallas_tok.concat_streams(col, seam)
     key_hi, key_lo, packed = position_sorted(stream)
     gs = mark_long_spans(grams_from_sorted(key_hi, key_lo, packed, n))
     t = gram_table(gs, capacity, pos_hi, max_pos=chunk.shape[0],
                    sort_mode=config.sort_mode, sort_impl=config.sort_impl,
-                   salt_bits=config.resolved_salt_bits)
+                   salt_bits=config.resolved_salt_bits,
+                   radix_geometry=config.resolved_radix_geometry)
     # Live sorted rows = real tokens + one poison row per overlong end.
     all_tokens = stream.total + overlong
     nm1 = jnp.uint32(n - 1)

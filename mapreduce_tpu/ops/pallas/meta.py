@@ -106,25 +106,114 @@ def lookup(kernel_name: str) -> Optional[KernelMeta]:
     return _KERNEL_META.get(kernel_name)
 
 
-def production_plans() -> list[VmemPlan]:
-    """Every SHIPPED kernel geometry's static footprint: the stable2
-    default, the sort3 compact and pair variants, and both radix levels'
-    partition kernel — the set the vmem pass certifies regardless of which
-    analysis-config models happened to trace them."""
-    from mapreduce_tpu.ops.pallas import radix, tokenize
+# -- geometry -> footprint constructors (ISSUE 12) ---------------------------
+#
+# The SAME BlockSpec/scratch arithmetic the kernel wrappers bind, as pure
+# jax-free functions of the geometry knobs: the wrappers' ``vmem_plan``
+# hooks delegate here, ``production_plans`` below derives the shipped list
+# from ``config.DEFAULT_GEOMETRY`` through the same constructors, and the
+# kernel-geometry search (``analysis/geometry.py``) prices CANDIDATE
+# geometries with them — one source of truth, so the certified list can
+# never silently drift from what the call sites bind.
 
+_LANES = 128  # TPU vector lanes; mirrors ops/pallas/tokenize.LANES
+
+
+def tokenize_plan(block_rows: int = 256, compact_slots: int = 0,
+                  w: int = 32, lane_major: bool = False, fused: bool = False,
+                  combiner_slots: int = 0, aux_rows: int = 96) -> VmemPlan:
+    """Static VMEM/SMEM footprint of one tokenize-kernel geometry — the
+    arithmetic behind ``ops/pallas/tokenize.vmem_plan`` (which delegates
+    here).  ``fused`` adds the seam-carry aux plane (``aux_rows`` tall)
+    and the in-VMEM transposed byte block of the fused map path;
+    ``combiner_slots`` the hot-key cache's four ``(C, LANES)`` planes
+    (cache state lives in revisited output blocks, the spill-scalar
+    idiom, so it is pipelined like any other output)."""
+    out_rows = compact_slots if compact_slots else block_rows // 2
+    n_scalars = 3 if compact_slots else 2
+    bufs = [Buffer("bytes-in", "vmem", block_rows * _LANES, True)]
+    if fused:
+        bufs.append(Buffer("seam-aux", "vmem", aux_rows * _LANES, True))
+        # The raw lane-view block is transposed (widened) in VMEM before
+        # the lookback loop; charge the int32 copy as resident scratch.
+        bufs.append(Buffer("transpose-scratch", "vmem",
+                           block_rows * _LANES * 4, False))
+    bufs += [Buffer(f"plane-out[{i}]", "vmem", out_rows * _LANES * 4, True)
+             for i in range(3)]
+    bufs += [Buffer(f"scalar[{i}]", "smem", 4, False)
+             for i in range(n_scalars)]
+    if combiner_slots:
+        bufs += [Buffer(f"combiner-cache[{name}]", "vmem",
+                        combiner_slots * _LANES * 4, True)
+                 for name in ("key_hi", "key_lo", "count", "packed")]
+    bufs.append(Buffer("carry-scratch", "vmem", (w + 1) * _LANES * 4, False))
+    geom = (f"block_rows={block_rows} w={w} slots={compact_slots or 'pair'}"
+            + (" lane-major" if lane_major else "")
+            + (" fused" if fused else "")
+            + (f" combiner={combiner_slots}" if combiner_slots else ""))
+    return VmemPlan(
+        kernel="_tokenize_kernel", geometry=geom, buffers=tuple(bufs),
+        vmem_limit_bytes=64 * 1024 * 1024 if compact_slots else None)
+
+
+def radix_plan(bits: int = 3, block_rows: int = 256,
+               slab_slack: int = 4) -> VmemPlan:
+    """Static VMEM/SMEM footprint of one radix-partition geometry — the
+    arithmetic behind ``ops/pallas/radix.vmem_plan`` (which delegates
+    here)."""
+    from mapreduce_tpu.config import radix_slab_cap
+
+    B = 1 << bits
+    cap = radix_slab_cap(bits, block_rows, slab_slack)
+    bufs = [Buffer(f"plane-in[{i}]", "vmem", block_rows * _LANES * 4, True)
+            for i in range(3)]
+    bufs += [Buffer(f"slab-out[{b}]", "vmem", cap * _LANES * 4, True)
+             for b in range(3 * B)]
+    bufs.append(Buffer("histogram", "smem", B * 4, False))
+    bufs.append(Buffer("spill", "smem", 4, False))
+    return VmemPlan(
+        kernel="_partition_kernel",
+        geometry=f"bits={bits} block_rows={block_rows} "
+                 f"slab_slack={slab_slack} (cap={cap})",
+        buffers=tuple(bufs))
+
+
+def geometry_plans(geom) -> list[VmemPlan]:
+    """Every kernel footprint one :class:`~mapreduce_tpu.config.Geometry`
+    implies — the stable2 compact window, the sort3 compact and pair
+    variants, the fused map path, the hot-key combiner window, the fused
+    spill fallback, and both radix digit widths (the candidate's own and
+    the widest legal B, the register-pressure extreme).  The geometry
+    search certifies candidates through exactly this list."""
     return [
-        tokenize.vmem_plan(block_rows=384, compact_slots=128,
-                           lane_major=True),   # stable2 default
-        tokenize.vmem_plan(block_rows=256, compact_slots=88),  # sort3 compact
-        tokenize.vmem_plan(block_rows=256, compact_slots=0),   # pair path
-        tokenize.vmem_plan(block_rows=384, compact_slots=128,
-                           lane_major=True, fused=True),  # fused map path
-        tokenize.vmem_plan(block_rows=512, compact_slots=128,
-                           lane_major=True, fused=True,
-                           combiner_slots=8),  # hot-key combiner (ISSUE 11)
-        tokenize.vmem_plan(block_rows=256, compact_slots=0,
-                           fused=True),        # fused spill fallback (pair)
-        radix.vmem_plan(),                                     # default B=8
-        radix.vmem_plan(bits=5),                               # widest legal B
+        tokenize_plan(block_rows=geom.block_rows,
+                      compact_slots=geom.compact_slots, lane_major=True),
+        tokenize_plan(block_rows=geom.sort3_block_rows,
+                      compact_slots=geom.sort3_slots),
+        tokenize_plan(block_rows=geom.pair_block_rows),
+        tokenize_plan(block_rows=geom.block_rows,
+                      compact_slots=geom.compact_slots, lane_major=True,
+                      fused=True, aux_rows=geom.aux_rows),
+        tokenize_plan(block_rows=geom.combiner_block_rows,
+                      compact_slots=geom.compact_slots, lane_major=True,
+                      fused=True, aux_rows=geom.aux_rows,
+                      combiner_slots=geom.combiner_slots),
+        tokenize_plan(block_rows=geom.pair_block_rows, fused=True,
+                      aux_rows=geom.aux_rows),
+        radix_plan(bits=geom.radix_bits, block_rows=geom.radix_block_rows,
+                   slab_slack=geom.radix_slab_slack),
+        radix_plan(bits=5, block_rows=geom.radix_block_rows,
+                   slab_slack=geom.radix_slab_slack),
     ]
+
+
+def production_plans() -> list[VmemPlan]:
+    """Every SHIPPED kernel geometry's static footprint — derived from
+    ``config.DEFAULT_GEOMETRY`` through the same constructor the geometry
+    search uses (ISSUE 12: one source of truth; the hand-maintained list
+    this replaces could silently drift from the kernel call sites).  The
+    set the vmem pass certifies regardless of which analysis-config
+    models happened to trace them."""
+    from mapreduce_tpu.config import DEFAULT_GEOMETRY
+
+    return geometry_plans(DEFAULT_GEOMETRY)

@@ -92,22 +92,13 @@ meta.register(meta.KernelMeta(
 def vmem_plan(bits: int = DEFAULT_BITS,
               block_rows: int = DEFAULT_BLOCK_ROWS,
               slab_slack: int = DEFAULT_SLAB_SLACK) -> meta.VmemPlan:
-    """Static VMEM/SMEM footprint of one partition-kernel geometry, from
-    the same BlockSpec arithmetic :func:`_partition_level` binds — the
-    analyzer's metadata hook (ops/pallas/meta.py)."""
-    B = 1 << bits
-    cap = min(slab_slack * block_rows // B, block_rows)
-    bufs = [meta.Buffer(f"plane-in[{i}]", "vmem", block_rows * LANES * 4,
-                        True) for i in range(3)]
-    bufs += [meta.Buffer(f"slab-out[{b}]", "vmem", cap * LANES * 4, True)
-             for b in range(3 * B)]
-    bufs.append(meta.Buffer("histogram", "smem", B * 4, False))
-    bufs.append(meta.Buffer("spill", "smem", 4, False))
-    return meta.VmemPlan(
-        kernel="_partition_kernel",
-        geometry=f"bits={bits} block_rows={block_rows} "
-                 f"slab_slack={slab_slack} (cap={cap})",
-        buffers=tuple(bufs))
+    """Static VMEM/SMEM footprint of one partition-kernel geometry — the
+    analyzer's metadata hook (ops/pallas/meta.py).  Delegates to the
+    jax-free :func:`...meta.radix_plan` constructor (ISSUE 12: one
+    arithmetic for search candidates, shipped plans, and what
+    :func:`_partition_level` binds)."""
+    return meta.radix_plan(bits=bits, block_rows=block_rows,
+                           slab_slack=slab_slack)
 
 
 def _partition_kernel(khi_ref, klo_ref, pck_ref, *out_refs, shift: int,
@@ -246,7 +237,9 @@ def radix_sort3(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array, *,
         # B output-ref triples are unrolled in the kernel; past 32 buckets
         # the jaxpr (and Mosaic's register pressure) outgrows the design.
         raise ValueError(f"bits must be in [1, 5], got {bits}")
-    cap = min(slab_slack * block_rows // B, block_rows)
+    from mapreduce_tpu.config import radix_slab_cap
+
+    cap = radix_slab_cap(bits, block_rows, slab_slack)
     if cap < 8 or cap % 8:
         raise ValueError(
             f"slab cap {cap} (= slab_slack*block_rows/B, clamped to "

@@ -83,41 +83,19 @@ meta.register(meta.KernelMeta(
 def vmem_plan(block_rows: int = DEFAULT_BLOCK_ROWS,
               compact_slots: int = 0, w: int = DEFAULT_MAX_TOKEN,
               lane_major: bool = False, fused: bool = False,
-              combiner_slots: int = 0) -> meta.VmemPlan:
-    """Static VMEM/SMEM footprint of one tokenize-kernel geometry, from
-    the same BlockSpec/scratch arithmetic :func:`_column_pass` binds —
-    the analyzer's metadata hook (ops/pallas/meta.py).  ``fused`` adds the
-    seam-carry aux plane and the in-VMEM transposed byte block of the
-    fused map path; ``combiner_slots`` the hot-key cache's four
-    ``(C, LANES)`` planes (ISSUE 11 — cache state lives in revisited
-    output blocks, the spill-scalar idiom, so it is pipelined like any
-    other output)."""
-    out_rows = compact_slots if compact_slots else block_rows // 2
-    n_scalars = 3 if compact_slots else 2
-    bufs = [meta.Buffer("bytes-in", "vmem", block_rows * LANES, True)]
-    if fused:
-        bufs.append(meta.Buffer("seam-aux", "vmem", AUX_ROWS * LANES, True))
-        # The raw lane-view block is transposed (widened) in VMEM before
-        # the lookback loop; charge the int32 copy as resident scratch.
-        bufs.append(meta.Buffer("transpose-scratch", "vmem",
-                                block_rows * LANES * 4, False))
-    bufs += [meta.Buffer(f"plane-out[{i}]", "vmem", out_rows * LANES * 4,
-                         True) for i in range(3)]
-    bufs += [meta.Buffer(f"scalar[{i}]", "smem", 4, False)
-             for i in range(n_scalars)]
-    if combiner_slots:
-        bufs += [meta.Buffer(f"combiner-cache[{name}]", "vmem",
-                             combiner_slots * LANES * 4, True)
-                 for name in ("key_hi", "key_lo", "count", "packed")]
-    bufs.append(meta.Buffer("carry-scratch", "vmem", (w + 1) * LANES * 4,
-                            False))
-    geom = (f"block_rows={block_rows} w={w} slots={compact_slots or 'pair'}"
-            + (" lane-major" if lane_major else "")
-            + (" fused" if fused else "")
-            + (f" combiner={combiner_slots}" if combiner_slots else ""))
-    return meta.VmemPlan(
-        kernel="_tokenize_kernel", geometry=geom, buffers=tuple(bufs),
-        vmem_limit_bytes=64 * 1024 * 1024 if compact_slots else None)
+              combiner_slots: int = 0,
+              aux_rows: int = AUX_ROWS) -> meta.VmemPlan:
+    """Static VMEM/SMEM footprint of one tokenize-kernel geometry — the
+    analyzer's metadata hook (ops/pallas/meta.py).  Delegates to the
+    jax-free :func:`...meta.tokenize_plan` constructor (ISSUE 12: the
+    SAME arithmetic prices search candidates and derives the shipped
+    ``production_plans`` list, so footprints cannot drift from what
+    :func:`_column_pass` binds)."""
+    return meta.tokenize_plan(block_rows=block_rows,
+                              compact_slots=compact_slots, w=w,
+                              lane_major=lane_major, fused=fused,
+                              combiner_slots=combiner_slots,
+                              aux_rows=aux_rows)
 
 
 class CombinerCache(NamedTuple):
@@ -592,9 +570,13 @@ def _column_pass(cols_padded: jax.Array, w: int, block_rows: int,
     params = _params_cls(vmem_limit_bytes=64 * 1024 * 1024) \
         if compact_slots else None
     if fused:
+        # The aux plane's height is a geometry knob (ISSUE 12): the spec
+        # reads it off the plane itself, so _seam_aux stays the single
+        # owner of the plane layout.
         in_specs = [pl.BlockSpec((LANES, block_rows), lambda i: (0, i),
                                  memory_space=pltpu.VMEM),
-                    pl.BlockSpec((AUX_ROWS, LANES), lambda i: (0, 0),
+                    pl.BlockSpec((fused_aux.shape[0], LANES),
+                                 lambda i: (0, 0),
                                  memory_space=pltpu.VMEM)]
         args = (cols_padded, fused_aux)
     else:
@@ -865,12 +847,15 @@ def _tokenize_split_impl(data, base_offset, max_token_bytes, block_rows,
     return col_stream, seam_stream, over_cols + over_seams, spill
 
 
-def _seam_aux(view: jax.Array, w: int) -> jax.Array:
-    """Build the fused kernel's ``(AUX_ROWS, LANES)`` seam-carry plane from
+def _seam_aux(view: jax.Array, w: int, aux_rows: int = AUX_ROWS) -> jax.Array:
+    """Build the fused kernel's ``(aux_rows, LANES)`` seam-carry plane from
     the raw ``(LANES, seg_len)`` lane view: rows ``[0, w+1)`` hold byte
     ``lane*L - (w+1) + c`` (the previous lane's tail; PAD for lane 0) and
-    rows ``[AUX_HEAD_ROW, AUX_ROWS)`` the next lane's first byte (PAD for
-    lane 127).  ~12 KB of static slices — noise next to the chunk."""
+    rows ``[AUX_HEAD_ROW, aux_rows)`` the next lane's first byte (PAD for
+    lane 127).  ~12 KB of static slices — noise next to the chunk.
+    ``aux_rows`` (a geometry knob, ISSUE 12) only sizes the tile-aligned
+    plane; the head row stays pinned at ``AUX_HEAD_ROW`` = 64, the W <= 63
+    bound, so rows past it are interchangeable replication."""
     seg_len = view.shape[1]
     pad = constants.PAD_BYTE
     tails = jnp.concatenate(
@@ -879,7 +864,7 @@ def _seam_aux(view: jax.Array, w: int) -> jax.Array:
     heads = jnp.concatenate(
         [view[1:, :1], jnp.full((1, 1), pad, jnp.uint8)], axis=0)
     mid = jnp.full((LANES, AUX_HEAD_ROW - (w + 1)), pad, jnp.uint8)
-    rep = jnp.broadcast_to(heads, (LANES, AUX_ROWS - AUX_HEAD_ROW))
+    rep = jnp.broadcast_to(heads, (LANES, aux_rows - AUX_HEAD_ROW))
     return jnp.concatenate([tails, mid, rep], axis=1).T
 
 
@@ -889,7 +874,8 @@ def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
                    block_rows: int | None = None,
                    interpret: bool | None = None,
                    lane_major: bool = False,
-                   combiner_slots: int = 0):
+                   combiner_slots: int = 0,
+                   aux_rows: int | None = None):
     """Fully fused map path (ISSUE 6): ``(stream, overlong, spill)`` from
     ONE kernel pass over the raw chunk bytes — no XLA transpose/pad of the
     input, no seam fix-up pass, no separate seam stream.
@@ -922,6 +908,14 @@ def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
     """
     w, seg_len, block_rows, interpret = _resolve_args(
         data, max_token_bytes, block_rows, interpret, compact_slots)
+    if aux_rows is None:
+        aux_rows = AUX_ROWS
+    if aux_rows % 32 or aux_rows <= AUX_HEAD_ROW:
+        # The plane is uint8 (tile grid (32, 128)) and the head row is
+        # pinned at AUX_HEAD_ROW (the W <= 63 bound): a geometry knob,
+        # validated like every other kernel envelope (ISSUE 12).
+        raise ValueError(f"aux_rows must be a multiple of 32 and > "
+                         f"{AUX_HEAD_ROW}, got {aux_rows}")
     if combiner_slots:
         if not compact_slots:
             raise ValueError("combiner_slots requires the compact path "
@@ -948,7 +942,8 @@ def tokenize_fused(data: jax.Array, *, compact_slots: int = 0,
     khi, klo, packed, overlong, n_tokens, spill, cache = _column_pass(
         view_padded, w, block_rows, data_rows=seg_len, interpret=interpret,
         compact_slots=compact_slots, lane_major=lane_major,
-        fused_aux=_seam_aux(view, w), combiner_slots=combiner_slots)
+        fused_aux=_seam_aux(view, w, aux_rows),
+        combiner_slots=combiner_slots)
     stream = _packed_stream(khi, klo, packed, n_tokens, base_offset)
     if combiner_slots:
         return stream, overlong, spill, cache

@@ -319,7 +319,8 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
                      total: jax.Array, capacity: int, pos_hi: jax.Array | int,
                      len_bits: int = 6, sort_mode: str = "sort3",
                      rescue_slots: int = 0, sort_impl: str = "xla",
-                     salt_bits: int = 0):
+                     salt_bits: int = 0,
+                     radix_geometry: tuple | None = None):
     """Aggregate pre-packed single-occurrence rows (the sort-lean path).
 
     ``packed`` = ``pos << len_bits | length`` per live row (all-ones for
@@ -462,8 +463,14 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
         # bucket skew falls back to the XLA sort inside radix_sort3.
         from mapreduce_tpu.ops.pallas import radix as radix_ops
 
+        # radix_geometry (ISSUE 12): an explicit (bits, block_rows,
+        # slab_slack) candidate from Config.geometry; None keeps the
+        # wrapper's call-time default resolution (the module-global
+        # geometry override tests rely on).
+        r_bits, r_rows, r_slack = radix_geometry or (None, None, None)
         key_hi, key_lo, packed = radix_ops.radix_sort3(
-            key_hi, key_lo, packed, impl=sort_impl)
+            key_hi, key_lo, packed, impl=sort_impl, bits=r_bits,
+            block_rows=r_rows, slab_slack=r_slack)
         _, rank = _segment_boundaries(key_hi, key_lo)
         run_min = None
     elif sort_mode == "stable2":
@@ -551,7 +558,8 @@ def from_packed_rows(key_hi: jax.Array, key_lo: jax.Array, packed: jax.Array,
 def _from_stream_packed(stream: TokenStream, capacity: int,
                         pos_hi: jax.Array | int,
                         sort_mode: str = "sort3", rescue_slots: int = 0,
-                        sort_impl: str = "xla", salt_bits: int = 0):
+                        sort_impl: str = "xla", salt_bits: int = 0,
+                        radix_geometry: tuple | None = None):
     """Packed fast path for token streams: see :func:`from_packed_rows`."""
     # Packed-plane-carrying streams (the pallas kernel's PackedTokenStream)
     # feed their raw plane straight into the sort — repacking from
@@ -568,14 +576,16 @@ def _from_stream_packed(stream: TokenStream, capacity: int,
     return from_packed_rows(stream.key_hi, stream.key_lo, packed, total,
                             capacity, pos_hi, len_bits=6,
                             sort_mode=sort_mode, rescue_slots=rescue_slots,
-                            sort_impl=sort_impl, salt_bits=salt_bits)
+                            sort_impl=sort_impl, salt_bits=salt_bits,
+                            radix_geometry=radix_geometry)
 
 
 def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
                 max_token_bytes: int | None = None,
                 max_pos: int | None = None,
                 sort_mode: str = "sort3", rescue_slots: int = 0,
-                sort_impl: str = "xla", salt_bits: int = 0):
+                sort_impl: str = "xla", salt_bits: int = 0,
+                radix_geometry: tuple | None = None):
     """Aggregate a per-byte :class:`TokenStream` into a fresh table.
 
     ``pos_hi`` identifies the source buffer (e.g. ``step * n_devices +
@@ -594,12 +604,15 @@ def from_stream(stream: TokenStream, capacity: int, pos_hi: jax.Array | int = 0,
     packed stream, which is the measured single-chip floor.  ``salt_bits``
     (fast path only, ``Config.combiner='salt'``) spreads hot keys over
     salted sort segments with an exact de-salting re-reduce
-    (:func:`from_packed_rows`).
+    (:func:`from_packed_rows`).  ``radix_geometry`` (ISSUE 12) is an
+    explicit (bits, block_rows, slab_slack) candidate for the radix
+    implementations; None keeps the module defaults.
     """
     if (max_token_bytes is not None and max_token_bytes <= 63
             and max_pos is not None and max_pos <= (1 << 26)):
         return _from_stream_packed(stream, capacity, pos_hi, sort_mode,
-                                   rescue_slots, sort_impl, salt_bits)
+                                   rescue_slots, sort_impl, salt_bits,
+                                   radix_geometry)
     if rescue_slots:
         raise ValueError("rescue_slots requires the packed fast path "
                          "(bounded max_token_bytes/max_pos)")

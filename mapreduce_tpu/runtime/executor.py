@@ -887,6 +887,18 @@ def _path_names(path) -> list[str]:
     return [_path_names(p)[0] for p in path]
 
 
+def _geometry_stamp(config) -> dict:
+    """run_start kernel-geometry fields (ISSUE 12, ledger v6): the compact
+    label always — 'default', a preset name, or 'custom' — plus the full
+    field dict on custom runs (a preset/default label already names its
+    spec; the A/B compare and the tuner knob read the label)."""
+    label = config.geometry_label
+    stamp = {"geometry": label}
+    if label == "custom":
+        stamp["geometry_spec"] = config.resolved_geometry.as_dict()
+    return stamp
+
+
 def _metrics_word_count(value) -> int:
     """Total words inside any finalize result shape, for RunMetrics.
 
@@ -1035,6 +1047,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      backend=config.resolved_backend(),
                      map_impl=config.map_impl,
                      combiner=config.resolved_combiner,
+                     **_geometry_stamp(config),
                      merge_strategy=merge_strategy, input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      retry=retry)
@@ -1224,6 +1237,7 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                          backend=config.resolved_backend(),
                          map_impl=config.map_impl,
                          combiner=config.resolved_combiner,
+                         **_geometry_stamp(config),
                          merge_strategy=merge_strategy,
                          input=_path_names(path),
                          resume_step=start_step, resume_offset=start_offset)

@@ -8,7 +8,7 @@ module closes the loop: a **pure, deterministic function of ledger
 records** proposes the next values for the tuned knobs
 
     ``inflight_groups`` / ``prefetch_depth`` / ``superstep`` /
-    ``chunk_bytes`` / ``combiner``
+    ``chunk_bytes`` / ``combiner`` / ``geometry``
 
 via a verdict-keyed rule table (below), in the spirit of CUDA-LLM's
 search-loop-with-a-certifier-as-fitness-gate and the config-search framing
@@ -31,6 +31,9 @@ cap instead of proposing a no-op):
 rule                trigger                                  move
 ==================  =======================================  ============
 no-signal           no phases/pipeline/timeline at all       stop
+revert-geometry     data verdict ``spill-bound``, geometry   geometry
+                    non-default (the searched window is too  default
+                    tall for this corpus's density)
 enable-combiner     data verdict ``skew-hot``, combiner off  combiner on
 grow-chunk          data verdict ``occupancy-starved``       chunk ×2
 shrink-chunk        data verdict ``table-pressure``          chunk ÷2
@@ -39,7 +42,13 @@ raise-prefetch      bottleneck resource ``reader``           prefetch ×2
 feed-window         h2d/staging-bound, window never filled   prefetch ×2
 raise-inflight      bottleneck ``h2d`` or ``staging``        inflight ×2
 try-superstep       device-bound AND window always full      superstep ×2
-device-bound        device-bound, window not saturated       stop
+try-geometry        device-bound, window NOT saturated,      geometry
+                    window occupancy <= 70%, geometry        'tall512'
+                    default, combiner off (compute is the
+                    ceiling and the windows have headroom:
+                    taller windows delete sort rows —
+                    ISSUE 12, the PR-11 arithmetic)
+device-bound        device-bound, saturated or no headroom   stop
 no-rule             nothing actionable (e.g. ``retire``)     stop
 ==================  =======================================  ============
 
@@ -68,17 +77,21 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, List, Optional
 
-from mapreduce_tpu.config import Config, DEFAULT_CONFIG
+from mapreduce_tpu.config import Config, DEFAULT_CONFIG, GEOMETRY_PRESETS
 from mapreduce_tpu.obs import datahealth, timeline
 
 #: Bumped when the rule table / proposal schema changes shape.
 TUNER_VERSION = 1
 
-#: The knobs this tuner owns, in proposal order.  ``combiner`` is the one
-#: non-numeric knob (ISSUE 11): a mode string moved by the data-shape
-#: rules, not doubled/halved by the pipeline ones.
+#: The knobs this tuner owns, in proposal order.  ``combiner`` (ISSUE 11)
+#: and ``geometry`` (ISSUE 12) are the non-numeric knobs: mode/preset
+#: strings moved by the data-shape and device rules, not doubled/halved
+#: by the pipeline ones.  Geometry knob values are 'default' or a
+#: ``config.GEOMETRY_PRESETS`` name — the tuned.json / ledger round-trip
+#: form (explicit Geometry dicts belong to the offline geomsearch
+#: driver, not the rule table).
 KNOBS = ("inflight_groups", "prefetch_depth", "superstep", "chunk_bytes",
-         "combiner")
+         "combiner", "geometry")
 
 #: Knobs that hold integers (everything result() must int-coerce).
 _INT_KNOBS = ("inflight_groups", "prefetch_depth", "superstep",
@@ -104,6 +117,13 @@ CONVERGED_SAVING_FRAC = 0.10
 #: ``full_frac`` at or above this = the window hit capacity on nearly
 #: every dispatch (the obs_report "always-full" gate).
 ALWAYS_FULL_FRAC = 0.9
+#: Mean stable2 window occupancy at or below which a taller window is
+#: worth probing (ISSUE 12): the 384 -> 512 step grows each window 1.33x,
+#: so <= 70% mean occupancy leaves headroom before the slot budget —
+#: and the exact spill fallback covers the tail either way.
+GEOMETRY_OCC_CEIL = 0.70
+#: The taller-window preset try-geometry proposes (config.GEOMETRY_PRESETS).
+GEOMETRY_TALL = "tall512"
 
 #: Data-health verdicts whose knob is outside the tuned set: noted in the
 #: trail, never moved on (verdict -> the knob that actually owns it).
@@ -120,7 +140,8 @@ def default_knobs() -> dict:
             "prefetch_depth": DEFAULT_CONFIG.resolved_prefetch_depth,
             "superstep": DEFAULT_CONFIG.superstep,
             "chunk_bytes": DEFAULT_CONFIG.chunk_bytes,
-            "combiner": DEFAULT_CONFIG.combiner}
+            "combiner": DEFAULT_CONFIG.combiner,
+            "geometry": DEFAULT_CONFIG.geometry_label}
 
 
 def validate_knobs(knobs: dict, backend: str = "auto") -> None:
@@ -130,11 +151,13 @@ def validate_knobs(knobs: dict, backend: str = "auto") -> None:
     ``ValueError`` exactly as Config would."""
     if backend not in ("auto", "xla", "pallas"):
         backend = "auto"  # resolved/CLI names like 'cpu' validate generically
+    geometry = str(knobs.get("geometry", "default"))
     Config(chunk_bytes=int(knobs["chunk_bytes"]),
            superstep=int(knobs["superstep"]),
            inflight_groups=int(knobs["inflight_groups"]),
            prefetch_depth=int(knobs["prefetch_depth"]),
            combiner=str(knobs.get("combiner", "off")),
+           geometry=None if geometry == "default" else geometry,
            backend=backend)
 
 
@@ -209,6 +232,20 @@ def derive_signals(records: Iterable[dict],
     combiner = (start or {}).get("combiner")
     if isinstance(combiner, str):
         config["combiner"] = combiner
+    geometry = (start or {}).get("geometry")
+    geometry_custom = False
+    if isinstance(geometry, str) \
+            and (geometry == "default" or geometry in GEOMETRY_PRESETS):
+        config["geometry"] = geometry
+    elif geometry not in (None, ""):
+        # A 'custom' label, a spec dict, or a future shape: the rule
+        # table moves preset names only, and a proposal echoing an
+        # unknowable value back through validate_knobs would kill the
+        # whole hint (Config rejects it).  The knob reads as 'default'
+        # for validation purposes and try-geometry is gated off below —
+        # an explicit candidate is the operator's (or the geomsearch
+        # driver's) choice to keep, not this table's to overwrite.
+        geometry_custom = True
 
     art = timeline.reconstruct(recs, run_id=chosen)
     bottleneck = art["bottleneck"] if art else None
@@ -231,6 +268,8 @@ def derive_signals(records: Iterable[dict],
             gb_per_s = round(b / 1e9 / el, 6)
 
     health = datahealth.classify_run(recs, run_id=chosen)
+    window_occ = ((health or {}).get("signals") or {}).get(
+        "window_occupancy")
     return {
         "run_id": chosen,
         "gb_per_s": gb_per_s,
@@ -247,6 +286,8 @@ def derive_signals(records: Iterable[dict],
         "full_frac": _num((pipeline or {}).get("full_frac")),
         "data_health": health,
         "data_verdict": (health or {}).get("verdict"),
+        "window_occupancy": window_occ,
+        "geometry_custom": geometry_custom,
     }
 
 
@@ -300,7 +341,7 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
             "signals": {k: sig[k] for k in
                         ("resource", "resource_source", "saving_frac",
                          "overlap_fraction", "depth_max", "full_frac",
-                         "data_verdict", "gb_per_s")},
+                         "data_verdict", "window_occupancy", "gb_per_s")},
             "trail": trail,
         }
 
@@ -319,7 +360,24 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
         return result("no-signal", "no telemetry to tune from",
                       converged=True)
 
-    # 2. Skew-hot data (ISSUE 11): the map-side combiner is the knob that
+    # 2. A searched geometry that SPILLS (ISSUE 12): the taller window
+    #    the search bought is too tall for this corpus's density — every
+    #    spilled chunk re-runs at full resolution, ~doubling its map
+    #    cost, which poisons every signal downstream.  Revert before any
+    #    other rule reads the wreckage.  (Default-geometry spill-bound
+    #    runs fall through to the foreign-knob note below: their knob is
+    #    --compact-slots, not a geometry this tuner set.)
+    if consider("revert-geometry",
+                verdict == "spill-bound" and cur["geometry"] != "default",
+                f"data verdict {verdict!r}; geometry {cur['geometry']!r}"):
+        return result("revert-geometry",
+                      "the searched taller-window geometry overflows its "
+                      "slot budget on this corpus (spill-bound: each "
+                      "fallback ~doubles that chunk's map cost): revert "
+                      "to the default geometry",
+                      {"geometry": "default"})
+
+    # 3. Skew-hot data (ISSUE 11): the map-side combiner is the knob that
     #    actually answers a Zipf-hot stream — enable it before any
     #    pipeline knob moves (collapsed duplicates change every downstream
     #    signal).  Already-on runs note the fact and fall through: the
@@ -451,6 +509,30 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
                           f"device-bound, window always full, superstep "
                           f"{cur['superstep']} at/past the "
                           f"{SUPERSTEP_MAX} cap: converged", converged=True)
+        # Window not saturated: compute itself is the ceiling — which is
+        #    exactly where the kernel geometry is the remaining lever
+        #    (ISSUE 12).  With measured window headroom, propose the
+        #    certified taller-window preset: fewer stable2 sort rows per
+        #    chunk at a spill risk the exact fallback bounds (and the
+        #    revert-geometry rule above unwinds if the probe spills).
+        #    Combiner-on runs already run tall windows; skip them.
+        occ = sig["window_occupancy"]
+        if consider("try-geometry",
+                    occ is not None and occ <= GEOMETRY_OCC_CEIL
+                    and cur["geometry"] == "default"
+                    and not sig["geometry_custom"]
+                    and cur["combiner"] == "off",
+                    f"device-bound, window occupancy {occ}, geometry "
+                    f"{cur['geometry']!r}, combiner {cur['combiner']!r}"):
+            return result("try-geometry",
+                          "device-bound with the dispatch window "
+                          f"unsaturated and kernel windows {occ:.0%} "
+                          "full: compute is the ceiling and the windows "
+                          "have headroom — try the certified "
+                          f"{GEOMETRY_TALL!r} geometry (taller windows, "
+                          "fewer aggregation-sort rows; the exact spill "
+                          "fallback bounds the risk)",
+                          {"geometry": GEOMETRY_TALL})
         return result("device-bound",
                       "the device is the measured critical path and the "
                       "window never saturated: compute itself is the "

@@ -113,6 +113,18 @@ def test_map_impl_knob_write_refused_with_stderr_trace(last_good, capsys,
     assert "refused" in err and "BENCH_MAP_IMPL" in err
 
 
+def test_geometry_knob_write_refused_with_stderr_trace(last_good, capsys,
+                                                       monkeypatch):
+    """BENCH_GEOMETRY (the ISSUE 12 searched-geometry A/B knob) is
+    measurement-altering: the class-based refusal covers it by default,
+    like every other A/B knob."""
+    monkeypatch.setenv("BENCH_GEOMETRY", "tall512")
+    bench._write_last_good(R5_GOOD)
+    assert not last_good.exists()
+    err = capsys.readouterr().err
+    assert "refused" in err and "BENCH_GEOMETRY" in err
+
+
 def test_probe_knobs_are_headline_safe(last_good, monkeypatch):
     """BENCH_RETRY_BUDGET_S / BENCH_PROBE_TIMEOUT_S shape pre-measurement
     reachability retries only (measurement-neutral, ADVICE r5): a run

@@ -71,11 +71,18 @@ def _probe_config(knobs: dict):
     from mapreduce_tpu.config import Config
 
     combiner = str(knobs.get("combiner", "off"))
+    geometry = knobs.get("geometry", "default")
     return Config(chunk_bytes=int(knobs["chunk_bytes"]),
                   superstep=int(knobs["superstep"]),
                   inflight_groups=int(knobs["inflight_groups"]),
                   prefetch_depth=int(knobs["prefetch_depth"]),
                   combiner=combiner,
+                  # The geometry knob (ISSUE 12) round-trips as 'default'
+                  # or a GEOMETRY_PRESETS name; dict-shaped candidates
+                  # come from the geomsearch driver, which passes them
+                  # through the same Config surface.
+                  geometry=None if geometry in (None, "default")
+                  else geometry,
                   # The hot-key cache only exists on the fused map path
                   # (resolved_combiner_slots is 0 elsewhere): a probe that
                   # left map_impl at 'split' would re-measure the IDENTICAL
@@ -166,12 +173,15 @@ def write_profile(out_path: str, key: str, entry: dict) -> None:
 
 
 def record_last_good(key: str, entry: dict, backend: str,
-                     path: str = LAST_GOOD_PATH) -> bool:
+                     path: str = LAST_GOOD_PATH,
+                     slot: str = "tuned") -> bool:
     """Record the tuned winner as a value-aware best-known entry under
-    ``best.tuned`` in BENCH_LAST_GOOD.json — same discipline as bench.py's
+    ``best.<slot>`` in BENCH_LAST_GOOD.json — same discipline as bench.py's
     per-metric records: CPU smoke runs refused (not TPU evidence), a
     >25% same-profile regression cannot displace the best-known record,
-    every refusal leaves a stderr trace."""
+    every refusal leaves a stderr trace.  ``slot`` separates record
+    families that must not displace each other (the geometry search's
+    winner rides ``best.geometry``, ISSUE 12)."""
     def refused(msg: str) -> bool:
         print(f"[autotune] last-good write refused: {msg}", file=sys.stderr,
               flush=True)
@@ -185,7 +195,7 @@ def record_last_good(key: str, entry: dict, backend: str,
     except (OSError, ValueError):
         prev = {}
     best = dict(prev.get("best") or {})
-    rec = best.get("tuned")
+    rec = best.get(slot)
     val = entry.get("measured_gbps")
     if val is None:
         return refused("no measured GB/s for the winner")
@@ -198,7 +208,7 @@ def record_last_good(key: str, entry: dict, backend: str,
             return refused(f"tuned profile {key!r} below best-known "
                            f"({val} < {old}, within {REGRESSION_FRAC:.0%}); "
                            "best-known kept")
-    best["tuned"] = {"value": val, "profile": key,
+    best[slot] = {"value": val, "profile": key,
                      "recorded_at": entry.get("recorded_at"),
                      "config": entry.get("config"),
                      "stopped": entry.get("stopped"),
@@ -345,7 +355,7 @@ def selftest() -> int:
     assert r["stopped"] == "converged", r["stopped"]
     assert r["winner"] == {"chunk_bytes": 1 << 25, "superstep": 1,
                            "inflight_groups": 4, "prefetch_depth": 16,
-                           "combiner": "off"}, \
+                           "combiner": "off", "geometry": "default"}, \
         r["winner"]
     assert [p["rule"] for p in r["trail"]] == \
         ["raise-prefetch", "raise-prefetch", "converged"], \

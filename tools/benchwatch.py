@@ -75,6 +75,37 @@ def run_step(out_path: str, name: str, cmd: list[str], env: dict,
     return proc.returncode == 0
 
 
+def _geom_env(profile_path: str, env: dict, log) -> dict | None:
+    """Map the freshest geomsearch winner onto bench's BENCH_GEOMETRY
+    knob (ISSUE 12): the geom row measures exactly the searched geometry
+    through the same harness as every other row.  None (with a logged
+    reason) when the probe step left no usable profile or the winner IS
+    the default — the suite then skips the geom A/B instead of measuring
+    a guess."""
+    import json
+
+    try:
+        with open(profile_path) as f:
+            profiles = json.load(f).get("profiles", {})
+    except (OSError, ValueError) as e:
+        log(f"geom rows skipped: no geomsearch profile ({e!r})")
+        return None
+    geo = {k: v for k, v in profiles.items() if "-geometry/" in k}
+    if not geo:
+        log(f"geom rows skipped: no geometry profile in {profile_path}")
+        return None
+    key, entry = max(geo.items(),
+                     key=lambda kv: kv[1].get("recorded_at") or "")
+    geom = (entry.get("config") or {}).get("geometry")
+    if geom in (None, "default"):
+        log(f"geom rows skipped: searched winner is the default [{key}]")
+        return None
+    log(f"geom config [{key}]: {geom} "
+        f"({entry.get('measured_gbps')} GB/s in-search)")
+    return {**env, "BENCH_GEOMETRY": geom if isinstance(geom, str)
+            else json.dumps(geom), "BENCH_TRACE": "1"}
+
+
 def _tuned_env(profile_path: str, env: dict, log) -> dict | None:
     """Map the freshest zipf autotune winner onto bench's A/B knobs
     (ISSUE 10): the tuned row measures exactly the searched config
@@ -146,7 +177,8 @@ def main() -> int:
                 # validates semantics, not the target): a ~minute parity
                 # smoke of the production kernel configs runs BEFORE any
                 # bench spends the window (VERDICT r4 next #8).
-                ("kernel-smoke", [sys.executable, "tools/kernel_smoke.py"],
+                ("kernel-smoke", [sys.executable, "tools/kernel_smoke.py",
+                                  "--geometry", "3"],
                  env),
                 # Defaults row = stable2 since round 5 (+5.9% measured).
                 ("bench-zipf", [sys.executable, "bench.py"], env),
@@ -208,6 +240,20 @@ def main() -> int:
                  {**ab, "BENCH_CORPUS": "natural", "BENCH_MB": "64",
                   "BENCH_MAP_IMPL": "fused",
                   "BENCH_COMBINER": "hot-cache", "BENCH_TRACE": "1"}),
+                # ISSUE 12 kernel-geometry search: jax-free shortlist ->
+                # graphcheck gate -> measured probe ranking, winner to
+                # the .geom.json profile the A/B rows below read.  The
+                # shortlist's Mosaic surfaces were smoked by the
+                # kernel-smoke --geometry step before this spends probe
+                # passes on them (BENCHMARKS.md round 12
+                # pre-registration: searched beats shipped on Zipf or
+                # the shipped constants get the dead-end-ledger entry).
+                ("geomsearch-zipf", [sys.executable, "tools/geomsearch.py",
+                                     "--probe", "--top", "3",
+                                     "--mb", "64",
+                                     "--out", args.out + ".geom.json",
+                                     "--keep-ledgers",
+                                     args.out + ".geom-ledgers"], env),
                 # Regression A/B rows: the previous default (sort3) and the
                 # uncompacted path.  segmin's stream-sized associative_scan
                 # wedges the chip (3 observations, BENCHMARKS.md round 4) —
@@ -267,6 +313,35 @@ def main() -> int:
             ]
             results = {}
             for name, cmd, e in steps:
+                if name == "geomsearch-zipf":
+                    # Stale-profile discipline (the autotune-zipf rule):
+                    # an earlier session's winner must never pose as this
+                    # window's.
+                    try:
+                        os.remove(args.out + ".geom.json")
+                    except OSError:
+                        pass
+                    results[name] = run_step(args.out, name, cmd, e, 1800)
+                    if not results[name]:
+                        log(args.out, "geom rows skipped: geomsearch-zipf "
+                                      "step failed or was abandoned")
+                        continue
+                    # ISSUE 12 searched-vs-shipped A/B, back-to-back for
+                    # temporal adjacency; both rows are A/B evidence
+                    # (LAST_GOOD refuses BENCH_GEOMETRY; the default row
+                    # carries no knob and may update the headline).
+                    geom = _geom_env(args.out + ".geom.json", env,
+                                     lambda m: log(args.out, m))
+                    if geom is None:
+                        continue
+                    results["bench-zipf-geom"] = run_step(
+                        args.out, "bench-zipf-geom",
+                        [sys.executable, "bench.py"], geom, 1800)
+                    results["bench-zipf-geom-default"] = run_step(
+                        args.out, "bench-zipf-geom-default",
+                        [sys.executable, "bench.py"],
+                        {**env, "BENCH_TRACE": "1"}, 1800)
+                    continue
                 if name == "autotune-zipf":
                     # A stale profile from an earlier session at the same
                     # --out path must never pose as this window's winner

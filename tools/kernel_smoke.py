@@ -27,7 +27,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="on-chip Mosaic kernel parity smoke (production "
+                    "configs; --geometry sweeps shortlist candidates)")
+    ap.add_argument("--geometry", type=int, default=0, metavar="K",
+                    help="ISSUE 12 sweep mode: smoke the top-K certified "
+                         "geometry-search candidates' Mosaic surfaces "
+                         "(stable2 + fused paths at each candidate's "
+                         "windows) BEFORE any probe pass spends device "
+                         "time — the PR-11 kernel-smoke discipline, "
+                         "generalized (0 = the production configs only)")
+    args = ap.parse_args(argv)
     budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "120"))
     if os.environ.get("BENCH_PROBE", "1") != "0":
         from mapreduce_tpu.runtime.probe import probe_once
@@ -59,7 +72,7 @@ def main() -> int:
 
     modes = {}
     ok = True
-    for name, cfg in {
+    configs = {
         "sort3_compact88": Config(backend="pallas", chunk_bytes=1 << 20,
                                   table_capacity=1 << 16, sort_mode="sort3"),
         "stable2_lane_major": Config(backend="pallas", chunk_bytes=1 << 20,
@@ -89,7 +102,28 @@ def main() -> int:
         "fused_salt": Config(backend="pallas", chunk_bytes=1 << 20,
                              table_capacity=1 << 16, map_impl="fused",
                              combiner="salt"),
-    }.items():
+    }
+    if args.geometry:
+        # ISSUE 12 sweep: every shortlisted candidate's Mosaic surface —
+        # new window heights move BlockSpec shapes and grid sizes, the
+        # exact class of lowering surprise interpret mode cannot see —
+        # smoked on the stable2 AND fused paths before tools/geomsearch.py
+        # --probe spends a measurement window on any of them.
+        from mapreduce_tpu.analysis import geometry as geom_mod
+
+        short = geom_mod.shortlist(geom_mod.enumerate_candidates(),
+                                   args.geometry)
+        for c in short:
+            if c.axis == "default":
+                continue  # the production configs above already cover it
+            configs[f"geom_{c.label}"] = Config(
+                backend="pallas", chunk_bytes=1 << 20,
+                table_capacity=1 << 16, geometry=c.geometry)
+            configs[f"geom_{c.label}_fused"] = Config(
+                backend="pallas", chunk_bytes=1 << 20,
+                table_capacity=1 << 16, map_impl="fused",
+                geometry=c.geometry)
+    for name, cfg in configs.items():
         try:
             r = wordcount.count_words(data, cfg)
             same = (r.words == oracle_r.words and r.counts == oracle_r.counts

@@ -303,7 +303,8 @@ def analyze_run(records: list) -> dict:
     pipeline = end.get("pipeline") if end else None
     header = {k: start.get(k) for k in
               ("driver", "job", "devices", "chunk_bytes", "superstep",
-               "backend", "map_impl", "combiner", "merge_strategy", "input",
+               "backend", "map_impl", "combiner", "geometry",
+               "geometry_spec", "merge_strategy", "input",
                "retry", "ledger_version")} if start else None
     classification = classify(phases)
     # Measured timeline (ISSUE 7): present only when the run carries
@@ -402,6 +403,15 @@ def render_run(a: dict, out) -> None:
         out.write("  map: fused (whole map chain — tokenize included — "
                   "runs inside dispatch; read dispatch shares of a "
                   "fused/split A/B with that in view)\n")
+    # Kernel-geometry line (ISSUE 12): which certified geometry set the
+    # run compiled — rendered only when it is NOT the shipped default
+    # (the map_impl/combiner precedent).  Future/custom shapes (a spec
+    # dict, an unknown label) print as-is, never crash.
+    geom = (a["header"] or {}).get("geometry")
+    if geom not in (None, "default"):
+        spec = (a["header"] or {}).get("geometry_spec")
+        out.write(f"  geometry: {geom}"
+                  + (f" {spec}" if spec else "") + "\n")
     p = a.get("pipeline")
     if p:
         out.write(f"  pipeline: inflight={p.get('inflight_groups')}  "
@@ -571,6 +581,13 @@ def compare_runs(a: dict, b: dict) -> list:
             va, vb = siga.get(k), sigb.get(k)
             if va is not None or vb is not None:
                 num(k, va, vb, "{:.4f}")
+    ga = (a.get("header") or {}).get("geometry")
+    gb = (b.get("header") or {}).get("geometry")
+    if (ga not in (None, "default")) or (gb not in (None, "default")):
+        # The geometry A/B row (ISSUE 12): which arm compiled which
+        # certified kernel-geometry set — the benchwatch
+        # bench-zipf-geom / -geom-default readout.
+        text("geometry", ga or "default", gb or "default")
     da, db = a.get("data") or {}, b.get("data") or {}
     ca, cb = da.get("combiner"), db.get("combiner")
     if (ca and ca != "off") or (cb and cb != "off"):
@@ -777,6 +794,13 @@ def selftest() -> int:
     assert f6["data_health"]["verdict"] == "clean", f6["data_health"]
     assert not f6["data_health"]["flags"]
     assert f6["data_health"]["signals"]["top_mass"] == round(24 / 60000, 6)
+    # fixture06 is also the ledger-v6 geometry-stamped run (ISSUE 12):
+    # the searched 'tall512' label must surface in the header and render
+    # as a geometry line, while runs with no stamp (every mini_ledger
+    # run) degrade to None and render nothing.
+    assert f6["header"]["ledger_version"] == 6, f6["header"]
+    assert f6["header"]["geometry"] == "tall512", f6["header"]
+    assert h8["header"]["geometry"] is None, h8["header"]
     # The human renderer must run over all artifacts without raising.
     import io
 
@@ -788,6 +812,7 @@ def selftest() -> int:
     render_run(e, buf)
     render_run(g7, buf)
     render_run(h8, buf)
+    render_run(f6, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
     assert ("combiner: hot-cache — 42000 hits (70.00% of tokens), "
@@ -803,6 +828,7 @@ def selftest() -> int:
     assert "timeline: 4 groups" in body
     assert "bottleneck: reader" in body
     assert "blocked on: reader 0.400s" in body
+    assert "geometry: tall512" in body
     assert "data health: spill-bound" in body
     assert "DATA spill-bound" in body and "DATA rescue-heavy" in body
     assert "spill fallbacks 3" in body
@@ -817,6 +843,11 @@ def selftest() -> int:
     assert "data verdict" in ctext and "spill-bound" in ctext \
         and "clean" in ctext, ctext
     assert "fallback_frac" in ctext and "top_mass" in ctext, ctext
+    # The geometry A/B row (ISSUE 12): the unstamped spill run reads as
+    # 'default' against fixture06's searched 'tall512'.
+    grow = next(line for line in ctext.splitlines()
+                if line.strip().startswith("geometry"))
+    assert "default" in grow and "tall512" in grow, grow
     cjson = io.StringIO()
     assert compare(ledger, ledger_b, cjson, as_json=True) == 0
     cobj = json.loads(cjson.getvalue())
@@ -841,6 +872,10 @@ def selftest() -> int:
     # opaque trail) must pass through and render without error (ISSUE 10
     # forward compat).
     assert f["tune"] is not None and f["tune"]["rule"] == "warp-rebalance"
+    # The future-shaped geometry stamp (a spec dict where the label
+    # string lives today) must surface and render without error.
+    assert f["header"]["geometry"] == {"block_rows": 1024,
+                                       "warp_slots": 7}, f["header"]
     render_run(f, io.StringIO())
     print("obs_report selftest ok "
           f"({a['step_records']} records, {len(a['spikes'])} spike, "
@@ -849,6 +884,7 @@ def selftest() -> int:
           f"pipeline flags, {len(c['map_flags'])} map flag, "
           f"timeline bottleneck={bn['resource']}, "
           f"data health={eh['verdict']}, tune rule={tn['rule']}, "
+          f"geometry={f6['header']['geometry']}, "
           "compare ok, future-ledger ok)")
     return 0
 

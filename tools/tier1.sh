@@ -32,4 +32,10 @@ timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_
 # over the checked-in tuner fixtures, hand-computed targets asserted —
 # also jax-free, seconds.
 timeout -k 5 60 python tools/autotune.py --selftest || { echo "TIER1: autotune selftest FAILED"; exit 1; }
+# Kernel-geometry search gate (ISSUE 12): enumerate -> certify -> price ->
+# rank over the tile lattice, the shipped production_plans reproduced
+# bit-for-bit by the same constructor, the 384-vs-512 PR-11 arithmetic
+# asserted, and the tuner's geometry knob walked over its fixtures —
+# jax-free, seconds.
+timeout -k 5 60 python tools/geomsearch.py --selftest || { echo "TIER1: geomsearch selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
