@@ -21,15 +21,21 @@ regions — all still used, now fed through one layer):
   spill-bound / rescue-heavy / skew-hot / occupancy-starved /
   table-pressure verdicts — the data-shape fitness signal next to the
   timeline's resource verdict;
+* :mod:`.fleet` — jax-free merge of multi-host per-process ledger shards
+  (``<ledger>.h<p>.jsonl``, ISSUE 13) into one clock-aligned fleet
+  timeline: per-host lanes, per-superstep straggler skew, collective
+  accounting, and the ``fleet_bottleneck`` verdict (straggler-bound /
+  collective-bound / balanced);
 * :mod:`.telemetry` — the facade the executor takes as ONE optional arg.
 
 Reporting: ``tools/obs_report.py`` renders a ledger/flight pair into a run
 summary with anomaly flags.  Schemas: ``docs/observability.md``.
 """
 
-from mapreduce_tpu.obs import datahealth, timeline
+from mapreduce_tpu.obs import datahealth, fleet, timeline
 from mapreduce_tpu.obs.flight import FlightRecorder, summarize_state
-from mapreduce_tpu.obs.ledger import LEDGER_VERSION, RunLedger, read_ledger
+from mapreduce_tpu.obs.ledger import (LEDGER_VERSION, RunLedger, read_ledger,
+                                      shard_flight_path, shard_path)
 from mapreduce_tpu.obs.registry import MetricsRegistry, get_registry
 from mapreduce_tpu.obs.spans import span
 from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
@@ -37,6 +43,7 @@ from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
 
 __all__ = [
     "FlightRecorder", "LEDGER_VERSION", "MetricsRegistry", "RunLedger",
-    "Telemetry", "datahealth", "device_memory_stats", "get_registry",
-    "maybe", "read_ledger", "span", "summarize_state", "timeline",
+    "Telemetry", "datahealth", "device_memory_stats", "fleet",
+    "get_registry", "maybe", "read_ledger", "shard_flight_path",
+    "shard_path", "span", "summarize_state", "timeline",
 ]

@@ -200,6 +200,50 @@ def latest_data_record(records: Iterable[dict]) -> Optional[dict]:
     return last
 
 
+#: Hottest-host share over the per-host mean that makes a fleet
+#: host-imbalanced (ISSUE 13): a host carrying >1.25x the mean bytes or
+#: tokens finishes proportionally late every superstep — the signal the
+#: ROADMAP-item-3 reduction-strategy planner needs before choosing
+#: keyrange vs tree vs hierarchical merges.
+HOST_IMBALANCE_RATIO = 1.25
+
+
+def classify_fleet(per_host: dict) -> dict:
+    """Per-host data counters -> the cross-host balance verdict
+    (ISSUE 13): ``{verdict, flags, signals}`` like :func:`classify`, over
+    ``{host: {"bytes": ..., "tokens": ...}}`` (any subset of counters;
+    ``obs/fleet.py`` builds the dict from each shard's ``host_bytes``
+    group fields and ``data`` records).  A counter present on >= 2 hosts
+    whose hottest host carries more than :data:`HOST_IMBALANCE_RATIO`
+    times the per-host mean fires ``host-imbalance``; the verdict is
+    ``host-imbalance`` or ``balanced``.  Unknown/extra fields ignored."""
+    signals: dict = {}
+    flags = []
+    for counter in ("bytes", "tokens"):
+        vals = {h: _num(v.get(counter)) for h, v in per_host.items()
+                if isinstance(v, dict) and _num(v.get(counter)) is not None}
+        if len(vals) < 2:
+            continue
+        mean = sum(vals.values()) / len(vals)
+        if mean <= 0:
+            continue
+        hot = max(sorted(vals), key=lambda h: vals[h])
+        ratio = vals[hot] / mean
+        signals[f"{counter}_ratio"] = round(ratio, 6)
+        signals[f"{counter}_hot_host"] = hot
+        if ratio > HOST_IMBALANCE_RATIO:
+            flags.append({"flag": "host-imbalance", "counter": counter,
+                          "detail": (f"host {hot} carries {ratio:.2f}x the "
+                                     f"per-host mean {counter} "
+                                     f"({vals[hot]:.0f} vs {mean:.0f}): it "
+                                     "finishes proportionally late every "
+                                     "superstep — rebalance the key ranges "
+                                     "or prefer a skew-tolerant merge "
+                                     "strategy (ROADMAP item 3)")})
+    verdict = "host-imbalance" if flags else "balanced"
+    return {"verdict": verdict, "flags": flags, "signals": signals}
+
+
 def resolve_combiner(records: Iterable[dict]) -> str:
     """Resolve ``Config.combiner='auto'`` against a prior run's ledger
     (ISSUE 11): the most recent ``data`` record's verdict decides —

@@ -45,11 +45,29 @@ tune           one per run on ``Config(autotune='hint')`` runs (ISSUE 10,
                (bottleneck resource, projected-saving fraction, data
                verdict, window stats), and the full rule-by-rule decision
                trail.  Advisory: the live run is never changed
+collective     one per run (ISSUE 13, inside the reduce phase): the
+               collective finish's monotonic interval (started_at/
+               ended_at) + merge strategy — the raw material of the
+               fleet timeline's ``collective`` lane (strategy *builds*
+               stay registry metrics: they happen at trace time)
 checkpoint     step, cursor_bytes, save_s, path
 retry          step, attempt, error
 failure        step, cursor_bytes, error, flight-dump path (if written)
 run_end        RunMetrics summary (bytes, words, elapsed, phases, GB/s)
 =============  ===========================================================
+
+Multi-host (ISSUE 13, ledger v7): every process of a multi-host run
+writes its OWN shard file ``<ledger>.h<process_index>.jsonl`` (see
+:func:`shard_path`) carrying every record kind above stamped with the
+process's ``host`` index; ``run_start`` additionally carries the
+process/device topology (``processes``, ``local_devices``) and the
+``clock`` pair ``{wall, mono}`` sampled at ``jax.distributed`` init, so
+``obs/fleet.py`` can rebase each host's monotonic lifecycle stamps onto
+the shared wall clock and merge the shards into one fleet timeline.  The
+coordinator keeps writing the merged-authoritative main file exactly as
+before; flight dumps land per host (:func:`shard_flight_path` on
+non-coordinators), so a remote failure leaves forensics from the host
+that actually failed instead of being swallowed by the write gate.
 
 Forward compatibility (ISSUE 7 satellite): ``run_start`` records carry
 ``ledger_version``; every consumer (:func:`read_ledger`, ``obs_report``,
@@ -81,8 +99,26 @@ from typing import Iterator, Optional
 #: 6 = run_start gains the kernel-geometry stamp (ISSUE 12: ``geometry``
 #: label — 'default', a preset name, or 'custom' — plus
 #: ``geometry_spec`` with the full field dict on custom runs), the knob
-#: the geometry search tunes and ``obs_report --compare`` diffs.
-LEDGER_VERSION = 6
+#: the geometry search tunes and ``obs_report --compare`` diffs;
+#: 7 = pod-scale observability (ISSUE 13): multi-host records carry the
+#: ``host`` process-index stamp, run_start the ``processes``/
+#: ``local_devices`` topology + the ``clock`` {wall, mono} alignment
+#: pair, every process writes its own ``<ledger>.h<p>.jsonl`` shard, and
+#: the new per-run ``collective`` record times the collective finish.
+LEDGER_VERSION = 7
+
+
+def shard_path(path: str, process_index: int) -> str:
+    """The per-host shard ledger next to the main file (ledger v7):
+    ``run.jsonl`` -> ``run.jsonl.h3.jsonl`` for process 3."""
+    return f"{path}.h{int(process_index)}.jsonl"
+
+
+def shard_flight_path(path: str, process_index: int) -> str:
+    """The host-suffixed flight-dump path (ISSUE 13 bugfix: a
+    non-coordinator failure dumps HERE instead of being swallowed by the
+    coordinator-only write gate)."""
+    return f"{path}.h{int(process_index)}.flight.json"
 
 
 class RunLedger:

@@ -128,6 +128,13 @@ class Telemetry:
         self.sample_device_stats = sample_device_stats
         self.run_id = ledger.run_id if ledger is not None \
             else uuid.uuid4().hex[:12]
+        # Multi-host attachment (ISSUE 13, ledger v7): the per-record host
+        # stamp, the run_start topology/clock extras, and the per-host
+        # shard ledger.  All empty/None on single-host runs, so their
+        # ledgers keep the exact pre-v7 record shapes.
+        self.host: dict = {}
+        self.topology: Optional[dict] = None
+        self.shard: Optional[ledger_mod.RunLedger] = None
         # Latest data-plane summary (ISSUE 8): the executor updates it at
         # every group retirement, so a flight dump on the failure path
         # carries the run's data-health snapshot as of the crash.
@@ -172,6 +179,51 @@ class Telemetry:
             cls._DISABLED = cls(enabled=False, sample_device_stats=False)
         return cls._DISABLED
 
+    # -- multi-host attachment (ISSUE 13) ---------------------------------
+
+    def attach_host(self, process_index: int, process_count: int, *,
+                    local_devices: Optional[int] = None,
+                    clock: Optional[dict] = None,
+                    shard: bool = True) -> None:
+        """Join this handle to a multi-host fleet (ledger v7).
+
+        Every subsequent ledger record is stamped with this process's
+        ``host`` index; ``run_start`` additionally carries the process/
+        device topology and the ``clock`` {wall, mono} pair (sampled at
+        ``jax.distributed`` init — ``parallel.distributed.run_epoch``)
+        that ``obs/fleet.py`` uses to rebase monotonic lifecycle stamps
+        onto the shared wall clock.  With ``shard=True`` (the global-SPMD
+        driver) the per-host shard ledger ``<ledger>.h<p>.jsonl`` opens
+        next to the main file and receives EVERY record regardless of the
+        coordinator write gate; non-coordinator processes also re-point
+        the flight recorder at the host-suffixed dump path, so a remote
+        failure leaves forensics from the host that actually failed.
+        ``shard=False`` (the per-host-driven mode, where each host owns
+        its whole ledger file already) stamps without a second file.
+        """
+        if not self.enabled:
+            return
+        self.host = {"host": int(process_index)}
+        self.topology = {"processes": int(process_count)}
+        if local_devices is not None:
+            self.topology["local_devices"] = int(local_devices)
+        if clock is not None:
+            self.topology["clock"] = dict(clock)
+        if shard and self.ledger is not None and self.shard is None:
+            self.shard = ledger_mod.RunLedger(
+                ledger_mod.shard_path(self.ledger.path, process_index),
+                self.ledger.run_id)
+        if shard and process_index != 0:
+            # Re-point the flight recorder even when no ledger is
+            # attached: in shard mode every process shares one path by
+            # contract, and N processes racing one flight.json would
+            # shred the failing host's forensics.
+            if self.ledger is not None:
+                self.flight_path = ledger_mod.shard_flight_path(
+                    self.ledger.path, process_index)
+            elif self.flight_path:
+                self.flight_path = f"{self.flight_path}.h{process_index}"
+
     # -- compile-event plumbing -------------------------------------------
 
     def _pend_compile(self, event: str, duration: float) -> None:
@@ -202,9 +254,22 @@ class Telemetry:
         if self.enabled and self.flight is not None:
             self.flight.record(kind, **fields)
 
-    def ledger_write(self, kind: str, **fields) -> None:
-        if self.enabled and self.ledger is not None:
+    def ledger_write(self, kind: str, write: bool = True, **fields) -> None:
+        """Write one record to the run ledger(s).  ``write=False`` (a
+        process that does not hold the multi-host write gate) skips the
+        merged-authoritative main file but still writes the per-host
+        shard when one is attached — in a fleet every process keeps its
+        own record (ISSUE 13)."""
+        if not self.enabled:
+            return
+        if self.host:
+            fields = {**self.host, **fields}
+        if kind == "run_start" and self.topology:
+            fields = {**fields, **self.topology}
+        if write and self.ledger is not None:
             self.ledger.write(kind, **fields)
+        if self.shard is not None:
+            self.shard.write(kind, **fields)
 
     def step_record(self, *, step_first: int, step_last: int,
                     group_bytes: int, cursor_bytes: int, timer,
@@ -218,7 +283,8 @@ class Telemetry:
         sample behind the run-end depth statistics.  ``write=False``
         (non-coordinator processes in multi-host runs) still advances the
         delta baseline so a later gate flip never reports a cumulative
-        blob as one step."""
+        blob as one step — and still lands the record in the per-host
+        shard ledger when one is attached (ISSUE 13)."""
         if not self.enabled:
             return
         phases = {k: round(v - self._last_phases.get(k, 0.0), 6)
@@ -239,7 +305,8 @@ class Telemetry:
                                   phases["dispatch"])
         self.event("step", step_first=step_first, step_last=step_last,
                    cursor_bytes=cursor_bytes)
-        if not (write and self.ledger is not None):
+        if not ((write and self.ledger is not None)
+                or self.shard is not None):
             return
         mem = device_memory_stats() if self.sample_device_stats else {}
         rec: dict[str, Any] = dict(step_first=step_first, step_last=step_last,
@@ -254,7 +321,7 @@ class Telemetry:
             rec["inflight_depth"] = inflight_depth
         if compiles:
             rec["compile_events"] = compiles
-        self.ledger.write("step", **rec)
+        self.ledger_write("step", write=write, **rec)
 
     def note_data(self, data: Optional[dict]) -> None:
         """Record the latest data-plane run summary (ISSUE 8) so the
@@ -301,11 +368,13 @@ class Telemetry:
                                 data_health=data_health)
 
     def close(self) -> None:
-        """Flush/close the ledger and stop receiving compile events."""
+        """Flush/close the ledger(s) and stop receiving compile events."""
         with _LIVE_LOCK:
             _LIVE.discard(self)
         if self.ledger is not None:
             self.ledger.close()
+        if self.shard is not None:
+            self.shard.close()
 
     def __enter__(self) -> "Telemetry":
         return self

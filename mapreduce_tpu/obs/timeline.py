@@ -43,6 +43,14 @@ retire      ``token_ready_at -> retired_at``: retire bookkeeping (window
             pop, staging-buffer recycling)
 ==========  ===============================================================
 
+Fleet extensions (ISSUE 13): ``reconstruct(..., host=h)`` keeps one
+process's records (multi-host shards stamp every record with ``host``),
+and ``with_collective=True`` adds a ``collective`` lane from the per-run
+``collective`` records (the observed collective-finish interval) — in
+the lanes/overlap output but never in the single-run ``bottleneck``
+election, which stays the STREAM verdict; cross-host straggler/collective
+attribution is ``obs/fleet.py``'s ``fleet_bottleneck``.
+
 The critical-path model: a lane's **exclusive seconds** (active while no
 other lane is) are the only seconds an infinitely fast version of it could
 remove from the measured span — overlapped seconds are covered by other
@@ -60,6 +68,14 @@ from typing import Iterable, Iterator, List, Optional, Tuple
 
 #: Resource lanes, in display/tie-break order.
 LANES: Tuple[str, ...] = ("reader", "staging", "h2d", "device", "retire")
+
+#: The fleet lane set (ISSUE 13): LANES plus the ``collective`` lane fed
+#: by the per-run ``collective`` ledger records (the observed finish
+#: interval).  The collective lane is opt-in (``with_collective=True``)
+#: and deliberately excluded from the single-run ``bottleneck`` election:
+#: that verdict names the STREAM's bounding resource — cross-host
+#: collective attribution is ``obs/fleet.py``'s ``fleet_bottleneck``.
+FLEET_LANES: Tuple[str, ...] = LANES + ("collective",)
 
 _Interval = Tuple[float, float]
 
@@ -164,12 +180,17 @@ def group_intervals(rec: dict) -> Optional[dict]:
 
 
 def iter_groups(records: Iterable[dict],
-                run_id: Optional[str] = None) -> Iterator[dict]:
+                run_id: Optional[str] = None,
+                host: Optional[int] = None) -> Iterator[dict]:
     """The ``group`` records of one run (the first run carrying any, when
-    ``run_id`` is not given).  Unknown kinds and malformed rows skip."""
+    ``run_id`` is not given).  ``host`` (ISSUE 13) keeps only records
+    stamped with that process index — the per-host lane filter fleet
+    merges reconstruct through.  Unknown kinds and malformed rows skip."""
     chosen = run_id
     for rec in records:
         if not isinstance(rec, dict) or rec.get("kind") != "group":
+            continue
+        if host is not None and rec.get("host") != host:
             continue
         if chosen is None:
             chosen = rec.get("run_id")
@@ -177,19 +198,55 @@ def iter_groups(records: Iterable[dict],
             yield rec
 
 
+def iter_collectives(records: Iterable[dict],
+                     run_id: Optional[str] = None,
+                     host: Optional[int] = None) -> Iterator[dict]:
+    """The ``collective`` records of one run (ISSUE 13), same selection
+    rules as :func:`iter_groups`."""
+    chosen = run_id
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "collective":
+            continue
+        if host is not None and rec.get("host") != host:
+            continue
+        if chosen is None:
+            chosen = rec.get("run_id")
+        if rec.get("run_id") == chosen:
+            yield rec
+
+
+def collective_interval(rec: dict) -> Optional[_Interval]:
+    """One ``collective`` record's (started_at, ended_at) interval, or
+    None when malformed/zero-length (forward compat: skip, never error)."""
+    s, e = _num(rec.get("started_at")), _num(rec.get("ended_at"))
+    if s is None or e is None or e <= s:
+        return None
+    return (s, e)
+
+
 # -- the reconstruction -----------------------------------------------------
 
 def reconstruct(records: Iterable[dict],
-                run_id: Optional[str] = None) -> Optional[dict]:
+                run_id: Optional[str] = None,
+                host: Optional[int] = None,
+                with_collective: bool = False) -> Optional[dict]:
     """Ledger records -> the timeline artifact (see module docstring), or
     None when the run carries no usable ``group`` records (pre-ISSUE-7
     ledgers degrade to "no timeline", never to an error).
 
+    ``host`` (ISSUE 13) restricts the reconstruction to one process's
+    records (fleet merges call this per host over clock-aligned shards);
+    ``with_collective=True`` adds the ``collective`` lane from the run's
+    ``collective`` records — visible in lanes/busy/overlap but excluded
+    from the ``bottleneck`` election (see :data:`FLEET_LANES`).
+
     All times in the artifact are seconds relative to the run's first
     observed lifecycle timestamp (``t0``), rounded to microseconds.
     """
+    if with_collective:
+        records = list(records)  # a second pass reads the collectives
     groups = []
-    for rec in iter_groups(records, run_id):
+    for rec in iter_groups(records, run_id, host=host):
         iv = group_intervals(rec)
         if iv is not None:
             groups.append((rec, iv))
@@ -199,6 +256,13 @@ def reconstruct(records: Iterable[dict],
     for _, iv in groups:
         for lane, span in iv.items():
             raw[lane].append(span)
+    if with_collective:
+        run = groups[0][0].get("run_id")
+        coll = [collective_interval(rec)
+                for rec in iter_collectives(records, run, host=host)]
+        coll = [iv for iv in coll if iv is not None]
+        if coll:
+            raw["collective"] = coll
     t0 = min(s for spans in raw.values() for s, _ in spans)
     lanes = {lane: _merge([(s - t0, e - t0) for s, e in spans])
              for lane, spans in raw.items()}
@@ -206,11 +270,11 @@ def reconstruct(records: Iterable[dict],
 
     busy = {lane: round(_total(spans), 6) for lane, spans in lanes.items()}
     overlap = {}
-    for i, a in enumerate(LANES):
-        for b in LANES[i + 1:]:
-            if lanes[a] and lanes[b]:
-                overlap[f"{a}+{b}"] = round(
-                    _intersection_s(lanes[a], lanes[b]), 6)
+    present = [ln for ln in FLEET_LANES if lanes.get(ln)]
+    for i, a in enumerate(present):
+        for b in present[i + 1:]:
+            overlap[f"{a}+{b}"] = round(
+                _intersection_s(lanes[a], lanes[b]), 6)
 
     # Device-idle gaps, each attributed to the lane covering most of it.
     gaps = []
@@ -271,7 +335,8 @@ def reconstruct(records: Iterable[dict],
 
 # Slice names per lane (what a Perfetto track shows on each group's slice).
 _SLICE = {"reader": "read", "staging": "stage", "h2d": "h2d",
-          "device": "compute", "retire": "retire"}
+          "device": "compute", "retire": "retire",
+          "collective": "collective"}
 
 
 def to_chrome_trace(records: Iterable[dict],

@@ -95,6 +95,11 @@ def initialize(coordinator_address: Optional[str] = None,
                   error=repr(e))
         raise
     init_s = time.perf_counter() - t0
+    # Shared run epoch (ISSUE 13): every process stamps its {wall, mono}
+    # clock pair the moment the cluster-wide runtime is up — the pair
+    # obs/fleet.py uses to rebase per-host monotonic lifecycle stamps
+    # onto the (NTP-shared) wall clock when merging ledger shards.
+    _stamp_epoch()
     reg.counter("distributed.inits").inc()
     reg.gauge("distributed.init_seconds").set(init_s)
     log_event(get_logger(), "distributed runtime up",
@@ -119,6 +124,32 @@ def _on_cloud_tpu() -> bool:
     """True when running under a TPU pod launcher that exports multi-worker
     topology env (single-worker VMs lack TPU_WORKER_HOSTNAMES)."""
     return bool(os.environ.get("TPU_WORKER_HOSTNAMES"))
+
+
+#: {wall, mono} sampled together at jax.distributed init (lazily on
+#: single-host runs).  wall - mono is this process's monotonic->wall
+#: offset; wall clocks are the cross-host reference (same box in the CPU
+#: harness, NTP on pods), so fleet merges align shard timelines with it.
+_RUN_EPOCH: Optional[dict] = None
+
+
+def _stamp_epoch() -> dict:
+    global _RUN_EPOCH
+    if _RUN_EPOCH is None:
+        _RUN_EPOCH = {"wall": round(time.time(), 6),
+                      "mono": round(time.perf_counter(), 6)}
+    return _RUN_EPOCH
+
+
+def run_epoch() -> dict:
+    """This process's clock-alignment pair (ISSUE 13): wall-clock and
+    monotonic seconds sampled together — stamped once at
+    :func:`initialize` success, lazily on first use otherwise.  Written
+    into every shard ledger's ``run_start`` as ``clock`` so
+    ``obs/fleet.py`` can rebase each host's monotonic lifecycle stamps
+    to the shared wall clock (``aligned = mono + (wall - mono_epoch)``).
+    """
+    return dict(_stamp_epoch())
 
 
 def is_coordinator() -> bool:

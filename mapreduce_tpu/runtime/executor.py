@@ -165,6 +165,12 @@ class _StreamHooks:
     # measured through the relay tunnel, a single 128 MB staged array moved
     # ~7x slower per byte than 32 MB chunk arrays (BENCHMARKS.md round 5).
     stage_arrival: Any = None
+    # Multi-host (ISSUE 13): the shard-row indices THIS process stages
+    # (run_job_global's `host_shards`).  When set, each group's lifecycle
+    # record also carries `host_bytes` — the bytes of real data this host
+    # staged, the per-host balance signal obs/fleet.py reads (group_bytes
+    # is the GLOBAL batch size, identical on every process).
+    host_rows: Any = None
 
 
 class _StagePool:
@@ -318,8 +324,9 @@ def _group_record(tel, write: bool, life: dict, token_ready_at: float,
             tel.registry.gauge("data.table_occupancy").set(data["occupancy"])
         if data.get("top_mass") is not None:
             tel.registry.gauge("data.top_mass").set(data["top_mass"])
-    if write:
-        tel.ledger_write("group", **rec)
+    # write gates the main (coordinator) file only: the per-host shard
+    # keeps every retired group's lifecycle (ISSUE 13).
+    tel.ledger_write("group", write=write, **rec)
 
 
 def _drive_stream(engine, job, config: Config, path, state,
@@ -470,20 +477,23 @@ def _drive_stream(engine, job, config: Config, path, state,
         checkpoint/resume is the recovery path.  The flight recorder dumps
         its ring + state summary FIRST, so a run that dies here leaves
         forensics on disk (the benchwatch wedge scenario) before the raise
-        unwinds.  Dump + failure record ride the write gate like every
-        other ledger artifact: in multi-host runs N processes racing one
-        flight.json would shred the forensics."""
+        unwinds.  The dump fires on EVERY host (ISSUE 13 bugfix: each
+        process owns a host-suffixed flight path, so a non-coordinator
+        failure leaves forensics from the host that actually failed
+        instead of being swallowed by the write gate — N processes no
+        longer race one file); the failure record rides the gate into
+        the main ledger and lands in the per-host shard regardless."""
         cursor = bytes_done if cursor is None else cursor
         tel.event("step_failed", step=step, attempt=attempts - 1,
                   error=repr(e))
-        if hooks.write_gate():
-            dump = tel.flight_dump(
-                context={"step": step, "offset": cursor,
-                         "attempts": attempts, "error": repr(e),
-                         "checkpoint_path": checkpoint_path},
-                state=snapshot)
-            tel.ledger_write("failure", step=step, cursor_bytes=cursor,
-                             error=repr(e), flight_dump=dump)
+        dump = tel.flight_dump(
+            context={"step": step, "offset": cursor,
+                     "attempts": attempts, "error": repr(e),
+                     "checkpoint_path": checkpoint_path},
+            state=snapshot)
+        tel.ledger_write("failure", step=step, cursor_bytes=cursor,
+                         error=repr(e), flight_dump=dump,
+                         write=hooks.write_gate())
         log_event(logger, "step failed", step=step, offset=cursor,
                   resume_hint=checkpoint_path
                   or "enable checkpointing to resume")
@@ -492,9 +502,8 @@ def _drive_stream(engine, job, config: Config, path, state,
     def retry_record(step, attempt, e):
         tel.registry.counter("executor.retry_attempts").inc()
         tel.event("retry", step=step, attempt=attempt, error=repr(e))
-        if hooks.write_gate():
-            tel.ledger_write("retry", step=step, attempt=attempt,
-                             error=repr(e))
+        tel.ledger_write("retry", step=step, attempt=attempt,
+                         error=repr(e), write=hooks.write_gate())
         log_event(logger, "step failed; retrying", step=step,
                   attempt=attempt)
 
@@ -755,6 +764,9 @@ def _drive_stream(engine, job, config: Config, path, state,
             read_t.pop(b.step, None)
         life = _group_life(group, read_at,
                            int(sum(int(b.lengths.sum()) for b in group)))
+        if hooks.host_rows is not None:
+            life["host_bytes"] = int(sum(
+                int(b.lengths[hooks.host_rows].sum()) for b in group))
         try:
             out, stats, staged = dispatch(state, group)
         except Exception as e:
@@ -795,11 +807,10 @@ def _drive_stream(engine, job, config: Config, path, state,
                                   fingerprint=fingerprint,
                                   file_index=last_file_dispatched)
             tel.event("checkpoint", step=step_index, cursor_bytes=bytes_done)
-            if hooks.write_gate():
-                tel.ledger_write(
-                    "checkpoint", step=step_index, cursor_bytes=bytes_done,
-                    save_s=round(timer["checkpoint"] - ck_before, 6),
-                    path=checkpoint_path)
+            tel.ledger_write(
+                "checkpoint", step=step_index, cursor_bytes=bytes_done,
+                save_s=round(timer["checkpoint"] - ck_before, 6),
+                path=checkpoint_path, write=hooks.write_gate())
             log_event(logger, "checkpoint", step=step_index,
                       path=checkpoint_path, writer=hooks.write_gate())
         return state
@@ -1040,6 +1051,16 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         stage_release=pool.give if retry > 0 else None,
         stage_arrival=None if retry > 0 else (lambda b: dataclasses.replace(
             b, data=jax.device_put(b.data, engine.sharding))))
+    if jax.process_count() > 1:
+        # Per-host-driven multi-host (mode a): each host owns its whole
+        # ledger file already, so no second shard file — but the records
+        # get the v7 host stamp + clock so obs/fleet.py can merge the
+        # per-host ledgers into one fleet timeline (ISSUE 13).
+        from mapreduce_tpu.parallel import distributed as dist
+
+        tel.attach_host(jax.process_index(), jax.process_count(),
+                        local_devices=len(jax.local_devices()),
+                        clock=dist.run_epoch(), shard=False)
     tel.registry.counter("executor.runs", driver="run_job").inc()
     tel.ledger_write("run_start", driver="run_job", job=job.identity(),
                      devices=n_dev, chunk_bytes=config.chunk_bytes,
@@ -1069,8 +1090,16 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         timer.stop("stream")
 
         with obs.span("reduce", timer):
+            fin_t0 = time.perf_counter()
             value = engine.finish(state)
             value = jax.tree.map(np.asarray, value)  # block + fetch the result
+            # One `collective` record per run (ISSUE 13): the observed
+            # finish interval + merge strategy — the fleet timeline's
+            # `collective` lane (strategy builds stay registry metrics).
+            tel.ledger_write("collective", op="finish",
+                             strategy=merge_strategy,
+                             started_at=round(fin_t0, 6),
+                             ended_at=round(time.perf_counter(), 6))
     except Exception as e:
         # Dispatch failures already dumped inside _drive_stream (with step
         # context); this catches everything else on the streaming path —
@@ -1225,22 +1254,33 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         restage=None,
         write_gate=dist.is_coordinator,
         retry=0,
-        stage_release=stage_release)
+        stage_release=stage_release,
+        host_rows=mine)
+    # Pod-scale observability (ISSUE 13, ledger v7): every process writes
+    # its own `<ledger>.h<p>.jsonl` shard (host-stamped records, the
+    # run-epoch clock pair in run_start, per-host flight dumps); the
+    # coordinator keeps the merged-authoritative main file it always
+    # wrote.  Pass the SAME ledger path (and ideally the same run_id) on
+    # every process; obs/fleet.py merges the shards.
+    if jax.process_count() > 1:
+        tel.attach_host(jax.process_index(), jax.process_count(),
+                        local_devices=len(jax.local_devices()),
+                        clock=dist.run_epoch())
     tel.registry.counter("executor.runs", driver="run_job_global").inc()
-    # The ledger rides the same gate as checkpoints: one file, written by
-    # the coordinator (every process still advances its delta baselines).
-    if dist.is_coordinator():
-        tel.ledger_write("run_start", driver="run_job_global",
-                         job=job.identity(), devices=n_dev,
-                         chunk_bytes=config.chunk_bytes,
-                         superstep=config.superstep,
-                         backend=config.resolved_backend(),
-                         map_impl=config.map_impl,
-                         combiner=config.resolved_combiner,
-                         **_geometry_stamp(config),
-                         merge_strategy=merge_strategy,
-                         input=_path_names(path),
-                         resume_step=start_step, resume_offset=start_offset)
+    # The main ledger rides the same gate as checkpoints: one file,
+    # written by the coordinator; the per-host shard gets every record.
+    tel.ledger_write("run_start", driver="run_job_global",
+                     job=job.identity(), devices=n_dev,
+                     chunk_bytes=config.chunk_bytes,
+                     superstep=config.superstep,
+                     backend=config.resolved_backend(),
+                     map_impl=config.map_impl,
+                     combiner=config.resolved_combiner,
+                     **_geometry_stamp(config),
+                     merge_strategy=merge_strategy,
+                     input=_path_names(path),
+                     resume_step=start_step, resume_offset=start_offset,
+                     write=dist.is_coordinator())
     timer.start("stream")
     try:
         state, bytes_done, _, pipe = _drive_stream(
@@ -1256,12 +1296,23 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         timer.stop("stream")
 
         with obs.span("reduce", timer):
+            fin_t0 = time.perf_counter()
             value = engine.finish(state)  # replicated: addressable everywhere
             value = jax.tree.map(np.asarray, value)
+            # Every host times the SAME collective finish from its own
+            # side (ISSUE 13): the fleet `collective` lane + the
+            # collective-bound half of the fleet_bottleneck verdict.
+            tel.ledger_write("collective", op="finish",
+                             strategy=merge_strategy,
+                             started_at=round(fin_t0, 6),
+                             ended_at=round(time.perf_counter(), 6),
+                             write=dist.is_coordinator())
     except Exception as e:
-        if dist.is_coordinator():  # same gate as every other ledger artifact
-            tel.flight_dump(context={"where": "run_job_global",
-                                     "error": repr(e)})
+        # Each process dumps to its OWN (host-suffixed) flight path —
+        # no shared-file race, and the failing host's forensics survive
+        # (ISSUE 13 bugfix: this used to ride the coordinator gate).
+        tel.flight_dump(context={"where": "run_job_global",
+                                 "error": repr(e)})
         raise
     total_s = timer.stop("total")
 
@@ -1269,8 +1320,10 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     words = _metrics_word_count(value)
     m = metrics_mod.RunMetrics(bytes_processed=bytes_done, words_counted=words,
                                elapsed_s=total_s, phases=dict(timer.phases))
-    if dist.is_coordinator():
-        tel.ledger_write("run_end", **m.as_dict(), pipeline=pipe)
+    # The shard's run_end carries THIS host's phase totals — the per-host
+    # straggler raw material; the coordinator's main record is unchanged.
+    tel.ledger_write("run_end", **m.as_dict(), pipeline=pipe,
+                     write=dist.is_coordinator())
     log_event(logger, "global run complete", **m.as_dict())
     bases = np.stack(bases_list) if bases_list else np.zeros((0, n_dev), np.int64)
     return RunResult(value=value, metrics=m, bases=bases, pipeline=pipe)

@@ -55,7 +55,11 @@ no-rule             nothing actionable (e.g. ``retire``)     stop
 Data-shape verdicts whose knobs are OUTSIDE the tuned set (spill-bound →
 ``--compact-slots``, rescue-heavy → the rescue budgets) are noted in the
 decision trail but never produce a move: the tuner must not thrash
-pipeline knobs to chase a data problem.  skew-hot GRADUATED from that
+pipeline knobs to chase a data problem.  The same discipline covers the
+cross-host ``fleet_bottleneck`` verdict (ISSUE 13): a merged fleet
+ledger's straggler-/collective-bound verdict rides the trail as a note —
+its knobs (data rebalancing, reduction strategy/schedule) are ROADMAP
+item 3's, and chasing it stays future work.  skew-hot GRADUATED from that
 set in ISSUE 11: the ``combiner`` knob is tuned now, so the
 ``enable-combiner`` rule flips the map-side hot-key cache on instead of
 just pointing at it.  The
@@ -209,6 +213,21 @@ def derive_signals(records: Iterable[dict],
                 chosen = r.get("run_id")
                 break
     recs = [r for r in records if r.get("run_id") == chosen]
+    # Merged fleet ledgers (ISSUE 13): every host's records share one
+    # run_id, and reconstructing a timeline from ALL of them would fuse
+    # the hosts' lanes into a chimera no host actually ran (cross-host
+    # "overlap" destroys exclusivity; data records double-count).  The
+    # synthesized `fleet` record marks a merged stream — anchor every
+    # single-host signal on ONE host's view (the coordinator when
+    # present) and let the fleet verdict carry the cross-host story.
+    fleet = next((r for r in recs if r.get("kind") == "fleet"), None)
+    if fleet is not None:
+        stamped = sorted({r.get("host") for r in recs
+                          if isinstance(r.get("host"), int)
+                          and not isinstance(r.get("host"), bool)})
+        if stamped:
+            anchor = 0 if 0 in stamped else stamped[0]
+            recs = [r for r in recs if r.get("host") in (anchor, None)]
     start = next((r for r in recs if r.get("kind") == "run_start"), None)
     end = next((r for r in recs if r.get("kind") == "run_end"), None)
     phases = dict((end or {}).get("phases") or {})
@@ -270,6 +289,13 @@ def derive_signals(records: Iterable[dict],
     health = datahealth.classify_run(recs, run_id=chosen)
     window_occ = ((health or {}).get("signals") or {}).get(
         "window_occupancy")
+    # Fleet verdict (ISSUE 13; `fleet` was detected above, before the
+    # host anchoring): noted in the decision trail, never chased — the
+    # knobs that answer a straggler-/collective-bound fleet (data
+    # rebalancing, reduction strategy/schedule) are ROADMAP item 3's,
+    # not this table's.
+    fleet_verdict = ((fleet or {}).get("fleet_bottleneck") or {}).get(
+        "verdict")
     return {
         "run_id": chosen,
         "gb_per_s": gb_per_s,
@@ -288,6 +314,8 @@ def derive_signals(records: Iterable[dict],
         "data_verdict": (health or {}).get("verdict"),
         "window_occupancy": window_occ,
         "geometry_custom": geometry_custom,
+        "fleet_bottleneck": fleet_verdict if isinstance(fleet_verdict, str)
+        else None,
     }
 
 
@@ -341,7 +369,8 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
             "signals": {k: sig[k] for k in
                         ("resource", "resource_source", "saving_frac",
                          "overlap_fraction", "depth_max", "full_frac",
-                         "data_verdict", "window_occupancy", "gb_per_s")},
+                         "data_verdict", "window_occupancy", "gb_per_s",
+                         "fleet_bottleneck")},
             "trail": trail,
         }
 
@@ -350,6 +379,18 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
     verdict = sig["data_verdict"]
     depth_max = sig["depth_max"]
     full_frac = sig["full_frac"]
+
+    # 0. Fleet verdict (ISSUE 13): noted, never chased.  A merged fleet
+    #    ledger's straggler-/collective-bound verdict names cross-host
+    #    costs whose knobs (data rebalancing, reduction strategy and
+    #    schedule — ROADMAP item 3) are outside this table; thrashing
+    #    single-host pipeline knobs against them would be the
+    #    foreign-data-knob mistake at fleet scale.
+    if sig.get("fleet_bottleneck") not in (None, "balanced"):
+        consider(f"fleet-{sig['fleet_bottleneck']}", False,
+                 f"fleet verdict {sig['fleet_bottleneck']!r} noted; its "
+                 "knobs (host balance / reduction strategy) are outside "
+                 "the tuned set — single-host rules proceed")
 
     # 1. Nothing to read at all: a run with no phases, no pipeline stats
     #    and no timeline gives the rules nothing — stop honestly.

@@ -10,7 +10,13 @@ checkpoint/resume recovery path a second launch completes.
 
 Usage: python global_worker.py <process_id> <n_processes> <port> \
     <corpus_path> <chunk_bytes> <devices_per_process> <ckpt_path> \
-    <crash_at_step>
+    <crash_at_step> [ledger_path]
+
+``ledger_path`` (optional, ISSUE 13): attach full telemetry at that
+shared path — every process then writes its own ``<ledger>.h<p>.jsonl``
+shard (with a shared run_id, so fleet merges pair runs explicitly), the
+coordinator the main file, and a crash dumps each host's flight recorder
+to its host-suffixed path.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ def main() -> int:
     port, path = sys.argv[3], sys.argv[4]
     chunk_bytes, dev_per_proc = int(sys.argv[5]), int(sys.argv[6])
     ckpt_path, crash_at = sys.argv[7], int(sys.argv[8])
+    ledger_path = sys.argv[9] if len(sys.argv) > 9 else None
 
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={dev_per_proc}")
@@ -57,15 +64,27 @@ def main() -> int:
         mr.Engine.step = crashing_step
 
     cfg = Config(chunk_bytes=chunk_bytes, table_capacity=1 << 10)
+    telemetry = None
+    if ledger_path:
+        from mapreduce_tpu.obs import Telemetry
+
+        # A shared run_id makes the shard pairing explicit (the fleet
+        # merge's documented multi-host contract).
+        telemetry = Telemetry.create(ledger_path=ledger_path,
+                                     run_id="gw-fleet")
     try:
         rr = executor.run_job_global(WordCountJob(cfg), path, config=cfg,
                                      checkpoint_path=ckpt_path,
-                                     checkpoint_every=1)
+                                     checkpoint_every=1,
+                                     telemetry=telemetry)
     except RuntimeError as e:
         if "injected crash" in str(e):
             print(json.dumps({"crashed": True, "process": pid}))
             return 17  # distinct code: the parent asserts the injection fired
         raise
+    finally:
+        if telemetry is not None:
+            telemetry.close()
 
     table = rr.value
     if dist.is_coordinator():

@@ -1,0 +1,425 @@
+"""Pod-scale observability (ISSUE 13): per-host ledger shards, the
+clock-aligned fleet merge, straggler/collective accounting and the
+fleet_bottleneck verdict — all falsified jax-free against crafted
+records and the checked-in two-host fixtures (the real 2-process run is
+tests/test_multihost.py's @slow half)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from mapreduce_tpu import obs
+from mapreduce_tpu.obs import datahealth, fleet, timeline
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "tools", "fixtures")
+
+
+def _rs(host, wall, mono, run_id="r1"):
+    return {"run_id": run_id, "kind": "run_start", "ledger_version": 7,
+            "host": host, "processes": 2,
+            "clock": {"wall": wall, "mono": mono}}
+
+
+def _group(host, step, staged, disp, ready, run_id="r1", **extra):
+    return {"run_id": run_id, "kind": "group", "host": host,
+            "step_first": step, "step_last": step, "steps": 1,
+            "group_bytes": 1024, "staged_at": staged, "dispatched_at": disp,
+            "token_ready_at": ready, "retired_at": ready + 0.01, **extra}
+
+
+def _coll(host, start, end, run_id="r1"):
+    return {"run_id": run_id, "kind": "collective", "host": host,
+            "op": "finish", "strategy": "tree", "started_at": start,
+            "ended_at": end}
+
+
+# -- shard naming ------------------------------------------------------------
+
+def test_shard_naming():
+    assert obs.shard_path("/x/run.jsonl", 3) == "/x/run.jsonl.h3.jsonl"
+    assert obs.shard_flight_path("/x/run.jsonl", 1) \
+        == "/x/run.jsonl.h1.flight.json"
+    assert fleet.shard_paths("/nonexistent/run.jsonl") == {}
+
+
+# -- clock alignment ---------------------------------------------------------
+
+def test_clock_alignment_rebases_monotonic_stamps():
+    """Two hosts with wildly different monotonic origins: the {wall, mono}
+    pairs rebase their stamps onto one clock, and the hand-computed
+    per-superstep skew falls out."""
+    by_host = {
+        0: [_rs(0, wall=100.0, mono=10.0),  # offset +90
+            _group(0, 0, 10.5, 10.6, 11.0)],  # ready at wall 101.0
+        1: [_rs(1, wall=100.0, mono=70.0),  # offset +30
+            _group(1, 0, 70.5, 70.6, 71.25)],  # ready at wall 101.25
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["aligned"] is True
+    assert [s["skew_s"] for s in view["supersteps"]] == [0.25]
+    assert view["straggler"]["slowest_host"] == 1
+
+
+def test_partial_clocks_degrade_to_unaligned():
+    """One shard without the v7 clock pair: mixing rebased and raw stamps
+    would fabricate skew, so the merge keeps raw monotonic values (valid
+    on one box: CLOCK_MONOTONIC is system-wide) and says so."""
+    by_host = {
+        0: [_rs(0, 100.0, 10.0), _group(0, 0, 10.5, 10.6, 11.0)],
+        1: [{"run_id": "r1", "kind": "run_start", "host": 1},
+            _group(1, 0, 10.5, 10.6, 11.5)],
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["aligned"] is False
+    # Raw stamps still compare (same origin here): 0.5 s skew.
+    assert view["supersteps"][0]["skew_s"] == 0.5
+
+
+# -- straggler decomposition -------------------------------------------------
+
+def test_straggler_skew_and_attribution_hand_computed():
+    by_host = {
+        0: [_rs(0, 0.0, 0.0), _group(0, 0, 0.1, 0.2, 1.0),
+            _group(0, 1, 1.0, 1.1, 2.0)],
+        1: [_rs(1, 0.0, 0.0), _group(1, 0, 0.1, 0.2, 1.4),
+            _group(1, 1, 1.4, 1.5, 2.6)],
+    }
+    view = fleet.fleet_view(by_host)
+    st = view["straggler"]
+    assert [s["skew_s"] for s in view["supersteps"]] == [0.4, 0.6]
+    assert st["total_skew_s"] == 1.0
+    assert st["slowest_host"] == 1 and st["slowest_wins"] == 2
+    assert st["per_host_lag_s"] == {"0": 0.0, "1": 1.0}
+    bn = view["fleet_bottleneck"]
+    assert bn["verdict"] == "straggler-bound"
+    # span 0.1 -> 2.61: skew 1.0 is 38% of it, saving = 1.0 (under span).
+    assert bn["projected_saving_s"] == 1.0, bn
+
+
+def test_straggler_saving_capped_at_span():
+    """A consistently slow host accumulates more lag-seconds than the
+    concurrent wall-clock could give back: the projected saving must not
+    exceed the fleet span."""
+    h0 = [_rs(0, 0.0, 0.0)] + [
+        _group(0, i, i * 0.1, i * 0.1 + 0.01, i * 0.1 + 0.02)
+        for i in range(10)]
+    h1 = [_rs(1, 0.0, 0.0)] + [
+        _group(1, i, i * 0.1, i * 0.1 + 0.01, i * 0.1 + 0.25)
+        for i in range(10)]
+    view = fleet.fleet_view({0: h0, 1: h1})
+    bn = view["fleet_bottleneck"]
+    assert bn["verdict"] == "straggler-bound"
+    assert bn["straggler_s"] > view["span_s"]
+    assert bn["projected_saving_s"] == view["span_s"]
+
+
+def test_slowest_host_tie_prefers_lower_id():
+    by_host = {
+        0: [_rs(0, 0.0, 0.0), _group(0, 0, 0.1, 0.2, 1.0)],
+        1: [_rs(1, 0.0, 0.0), _group(1, 0, 0.1, 0.2, 1.0)],
+        2: [_rs(2, 0.0, 0.0), _group(2, 0, 0.1, 0.2, 0.5)],
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["supersteps"][0]["slowest_host"] == 0  # tie at 1.0 -> h0
+
+
+# -- collective accounting ---------------------------------------------------
+
+def test_collective_bound_verdict():
+    by_host = {
+        0: [_rs(0, 0.0, 0.0), _group(0, 0, 0.1, 0.2, 1.0),
+            _coll(0, 1.05, 3.05)],
+        1: [_rs(1, 0.0, 0.0), _group(1, 0, 0.1, 0.2, 1.02),
+            _coll(1, 1.05, 3.05)],
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["collective"]["mean_s"] == 2.0
+    bn = view["fleet_bottleneck"]
+    assert bn["verdict"] == "collective-bound"
+    assert bn["projected_saving_s"] == 2.0
+
+
+def test_balanced_verdict_below_threshold():
+    by_host = {
+        0: [_rs(0, 0.0, 0.0), _group(0, 0, 0.1, 0.2, 2.0),
+            _coll(0, 2.02, 2.06)],
+        1: [_rs(1, 0.0, 0.0), _group(1, 0, 0.1, 0.2, 2.01),
+            _coll(1, 2.02, 2.06)],
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["fleet_bottleneck"]["verdict"] == "balanced"
+
+
+# -- host imbalance (datahealth) ---------------------------------------------
+
+def test_classify_fleet_hand_arithmetic():
+    out = datahealth.classify_fleet({0: {"bytes": 1000, "tokens": 100},
+                                     1: {"bytes": 3000, "tokens": 110}})
+    assert out["verdict"] == "host-imbalance"
+    assert out["signals"]["bytes_ratio"] == 1.5  # 3000 / 2000
+    assert out["signals"]["bytes_hot_host"] == 1
+    # tokens ratio 110/105 well under the gate: only bytes flags.
+    assert [f["counter"] for f in out["flags"]] == ["bytes"]
+
+
+def test_classify_fleet_threshold_edge_and_degenerates():
+    # Exactly at the 1.25 gate: NOT imbalanced (strict >).
+    at = datahealth.classify_fleet({0: {"bytes": 750}, 1: {"bytes": 1250}})
+    assert at["signals"]["bytes_ratio"] == 1.25
+    assert at["verdict"] == "balanced"
+    # One host / missing counters / zero totals: no signal, no crash.
+    assert datahealth.classify_fleet({0: {"bytes": 10}})["verdict"] \
+        == "balanced"
+    assert datahealth.classify_fleet({0: {}, 1: {"x": 1}})["verdict"] \
+        == "balanced"
+    assert datahealth.classify_fleet({0: {"bytes": 0},
+                                      1: {"bytes": 0}})["verdict"] \
+        == "balanced"
+
+
+def test_fleet_view_feeds_imbalance_from_host_bytes():
+    by_host = {
+        0: [_rs(0, 0.0, 0.0),
+            _group(0, 0, 0.1, 0.2, 1.0, host_bytes=100)],
+        1: [_rs(1, 0.0, 0.0),
+            _group(1, 0, 0.1, 0.2, 1.0, host_bytes=300)],
+    }
+    view = fleet.fleet_view(by_host)
+    assert view["per_host"]["1"]["host_bytes"] == 300
+    assert view["imbalance"]["verdict"] == "host-imbalance"
+
+
+# -- timeline collective lane + host filter ----------------------------------
+
+def test_timeline_collective_lane_opt_in_and_excluded_from_bottleneck():
+    recs = [_group(0, 0, 0.1, 0.2, 1.0), _coll(0, 1.05, 9.0)]
+    plain = timeline.reconstruct(recs)
+    assert "collective" not in {k for k, v in plain["lanes"].items() if v}
+    art = timeline.reconstruct(recs, with_collective=True)
+    assert art["lanes"]["collective"] == [[0.95, 8.9]]
+    assert art["lane_busy_s"]["collective"] == 7.95
+    # 7.95 s of collective-exclusive time, yet the verdict stays the
+    # STREAM's bounding resource (device here) by design.
+    assert art["bottleneck"]["resource"] == "device"
+    assert "collective" in timeline.FLEET_LANES
+
+
+def test_timeline_host_filter_on_mixed_records():
+    """A mode-(a) style single file holding both hosts' stamped records:
+    the host filter reconstructs one process's lanes."""
+    recs = [_group(0, 0, 0.1, 0.2, 1.0), _group(1, 0, 0.1, 0.2, 2.0)]
+    a0 = timeline.reconstruct(recs, host=0)
+    a1 = timeline.reconstruct(recs, host=1)
+    assert a0["groups"] == 1 and a1["groups"] == 1
+    assert a0["lane_busy_s"]["device"] == 0.8
+    assert a1["lane_busy_s"]["device"] == 1.8
+    assert timeline.reconstruct(recs, host=7) is None
+
+
+# -- merge determinism + merged stream ---------------------------------------
+
+def test_fixture_merge_byte_stable_and_carries_fleet_record():
+    main = os.path.join(FIXTURES, "fleet_ledger.jsonl")
+    paths = fleet.shard_paths(main)
+    assert sorted(paths) == [0, 1]
+
+    def merge_once():
+        by_host = {h: fleet.read_jsonl(p) for h, p in paths.items()}
+        return "\n".join(json.dumps(r, sort_keys=True)
+                         for r in fleet.merged_records(by_host))
+
+    a, b = merge_once(), merge_once()
+    assert a == b, "merged fleet stream must be byte-stable"
+    last = json.loads(a.splitlines()[-1])
+    assert last["kind"] == "fleet"
+    assert last["fleet_bottleneck"]["verdict"] == "straggler-bound"
+    assert last["straggler"]["total_skew_s"] == 2.0
+
+
+def test_run_selection_pairs_last_runs_and_honors_run_id():
+    old = [_rs(0, 0.0, 0.0, run_id="old"),
+           _group(0, 0, 0.1, 0.2, 5.0, run_id="old")]
+    new = [_rs(0, 0.0, 0.0, run_id="new"),
+           _group(0, 0, 0.1, 0.2, 1.0, run_id="new")]
+    by_host = {0: old + new,
+               1: [_rs(1, 0.0, 0.0, run_id="new"),
+                   _group(1, 0, 0.1, 0.2, 1.2, run_id="new")]}
+    view = fleet.fleet_view(by_host)  # default: each shard's LAST run
+    assert view["run_ids"] == {"0": "new", "1": "new"}
+    assert view["supersteps"][0]["skew_s"] == 0.2
+    old_view = fleet.fleet_view(by_host, run_id="old")
+    assert old_view["hosts"] == [0]  # host 1 never ran it
+
+
+def test_run_selection_splits_same_run_id_instances():
+    """The crash+relaunch recovery appends a SECOND run under the same
+    shared run_id (the documented multi-host contract + append-mode
+    shards): every run_start opens a new instance, so the crashed
+    attempt and its recovery never fuse into one fleet view."""
+    crashed = [_rs(0, 0.0, 0.0, run_id="gw"),
+               _group(0, 0, 0.1, 0.2, 9.0, run_id="gw")]
+    recovery = [_rs(0, 100.0, 100.0, run_id="gw"),
+                _group(0, 0, 100.1, 100.2, 100.5, run_id="gw"),
+                _group(0, 1, 100.5, 100.6, 101.0, run_id="gw")]
+    rid, recs = fleet.select_run(crashed + recovery)
+    assert rid == "gw" and recs == recovery
+    rid, recs = fleet.select_run(crashed + recovery, run_id="gw")
+    assert recs == recovery, "an explicit id picks its LAST instance"
+    view = fleet.fleet_view({0: crashed + recovery,
+                             1: [_rs(1, 100.0, 100.0, run_id="gw"),
+                                 _group(1, 0, 100.1, 100.2, 100.6,
+                                        run_id="gw"),
+                                 _group(1, 1, 100.5, 100.6, 101.2,
+                                        run_id="gw")]})
+    # Only the recovery instance merges: 2 supersteps, no 9.0 s stamp.
+    assert view["per_host"]["0"]["groups"] == 2
+    assert [s["skew_s"] for s in view["supersteps"]] == [0.1, 0.2]
+
+
+# -- the tuner consumes fleet_bottleneck (trail note only) -------------------
+
+def test_tuner_notes_fleet_verdict_without_chasing_it():
+    from mapreduce_tpu import tuning
+
+    main = os.path.join(FIXTURES, "fleet_ledger.jsonl")
+    by_host = {h: fleet.read_jsonl(p)
+               for h, p in fleet.shard_paths(main).items()}
+    merged = fleet.merged_records(by_host)
+    prop = tuning.propose(merged, run_id="fleet01")
+    assert prop["signals"]["fleet_bottleneck"] == "straggler-bound"
+    note = next(t for t in prop["trail"]
+                if t["rule"] == "fleet-straggler-bound")
+    assert note["fired"] is False and "outside the tuned set" in note["why"]
+    # The fired rule is a normal single-host one — the fleet verdict
+    # must never produce a knob move on its own.
+    assert prop["rule"] != "fleet-straggler-bound"
+    # Shardless ledgers carry no fleet signal at all.
+    plain = tuning.propose([_rs(0, 0.0, 0.0),
+                            _group(0, 0, 0.1, 0.2, 1.0)])
+    assert plain["signals"]["fleet_bottleneck"] is None
+    assert not any(t["rule"].startswith("fleet-") for t in plain["trail"])
+
+
+def test_tuner_signals_anchor_on_one_host_in_merged_ledgers():
+    """A merged fleet stream holds every host's records under one run_id:
+    reconstructing a timeline from ALL of them would fuse the hosts'
+    lanes into a chimera no host ran.  derive_signals must anchor the
+    single-host signals on the coordinator's records (the fleet record
+    marks the stream), so the fired rule reads a real host's view."""
+    from mapreduce_tpu import tuning
+
+    # Host 0 is device-bound; host 1's enormous reader interval would
+    # dominate a fused timeline and misfire raise-prefetch.
+    by_host = {
+        0: [_rs(0, 0.0, 0.0),
+            {"run_id": "r1", "kind": "group", "host": 0, "step_first": 0,
+             "step_last": 0, "steps": 1, "group_bytes": 1024,
+             "read_at": 0.0, "staged_at": 0.1, "dispatched_at": 0.2,
+             "token_ready_at": 5.0, "retired_at": 5.01}],
+        1: [_rs(1, 0.0, 0.0),
+            {"run_id": "r1", "kind": "group", "host": 1, "step_first": 0,
+             "step_last": 0, "steps": 1, "group_bytes": 1024,
+             "read_at": 0.0, "staged_at": 6.0, "dispatched_at": 6.1,
+             "token_ready_at": 6.3, "retired_at": 6.31}],
+    }
+    merged = fleet.merged_records(by_host)
+    sig = tuning.derive_signals(merged, run_id="r1")
+    assert sig["resource"] == "device", sig["resource"]
+    # The unanchored chimera would have said reader (host 1's 6 s read
+    # interval is the only exclusive time once the lanes fuse).
+    chimera = timeline.reconstruct(
+        [r for r in merged if r.get("kind") == "group"], run_id="r1")
+    assert chimera["bottleneck"]["resource"] == "reader"
+
+
+# -- telemetry shard writer --------------------------------------------------
+
+def test_attach_host_suffixes_flight_path_without_a_ledger(tmp_path):
+    """Shard mode with a flight path but NO ledger (Telemetry.create
+    supports it): non-coordinators must still move to a host-suffixed
+    dump path — N processes racing one flight.json would shred the
+    failing host's forensics."""
+    fp = str(tmp_path / "flight.json")
+    tel = obs.Telemetry(flight_path=fp)
+    tel.attach_host(1, 2)
+    assert tel.flight_path == fp + ".h1"
+    coord = obs.Telemetry(flight_path=fp)
+    coord.attach_host(0, 2)
+    assert coord.flight_path == fp  # the coordinator keeps the base path
+
+
+def test_telemetry_attach_host_opens_shard_and_stamps(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    tel = obs.Telemetry.create(ledger_path=p, run_id="tshard")
+    tel.attach_host(1, 2, local_devices=2,
+                    clock={"wall": 10.0, "mono": 3.0})
+    # Non-coordinator: the flight path moves to the host-suffixed file.
+    assert tel.flight_path == obs.shard_flight_path(p, 1)
+    tel.ledger_write("run_start", driver="t", write=False)  # gated off main
+    tel.ledger_write("group", step_first=0, write=False)
+    tel.ledger_write("checkpoint", step=1, write=True)
+    tel.close()
+    # Main file got only the gated record; the shard got everything,
+    # host-stamped, with the topology + clock on run_start.
+    main = list(obs.read_ledger(p))
+    assert [r["kind"] for r in main] == ["checkpoint"]
+    shard = list(obs.read_ledger(obs.shard_path(p, 1)))
+    assert [r["kind"] for r in shard] == ["run_start", "group", "checkpoint"]
+    assert all(r["host"] == 1 for r in shard)
+    start = shard[0]
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 7
+    assert start["processes"] == 2 and start["local_devices"] == 2
+    assert start["clock"] == {"wall": 10.0, "mono": 3.0}
+    assert "clock" not in shard[1], "topology rides run_start only"
+
+
+def test_telemetry_attach_host_stamp_only_mode(tmp_path):
+    """shard=False (the per-host-driven mode a): host stamps, no second
+    file — the host's own ledger IS its shard."""
+    p = str(tmp_path / "a.jsonl")
+    tel = obs.Telemetry.create(ledger_path=p, run_id="tmodea")
+    tel.attach_host(0, 3, clock={"wall": 1.0, "mono": 0.5}, shard=False)
+    tel.ledger_write("run_start", driver="t")
+    tel.close()
+    assert tel.shard is None
+    assert not os.path.exists(obs.shard_path(p, 0))
+    rec = next(obs.read_ledger(p))
+    assert rec["host"] == 0 and rec["processes"] == 3
+
+
+def test_telemetry_disabled_attach_is_noop(tmp_path):
+    tel = obs.Telemetry.disabled()
+    tel.attach_host(1, 2)
+    assert tel.shard is None and not tel.host
+
+
+# -- forward compat ----------------------------------------------------------
+
+def test_future_ledger_records_flow_through_fleet_consumers():
+    """The v7-shaped records in the future fixture (host/clock topology,
+    a collective with unknown fields, a `fleet` record with an unknown
+    verdict) must be skipped-or-consumed by every reader, never fatal."""
+    from mapreduce_tpu import tuning
+
+    fut = os.path.join(FIXTURES, "future_ledger.jsonl")
+    recs = fleet.read_jsonl(fut)
+    art = timeline.reconstruct(recs, with_collective=True)
+    assert art is not None and art["lanes"].get("collective"), art
+    view = fleet.fleet_view(fleet.load_shards([fut]))
+    assert view is not None and view["hosts"] == [0]
+    prop = tuning.propose(recs, run_id="future01")
+    assert prop["signals"]["fleet_bottleneck"] == "entanglement-bound"
+    assert any(t["rule"] == "fleet-entanglement-bound"
+               for t in prop["trail"])
+    assert prop["rule"] != "fleet-entanglement-bound"
+
+
+@pytest.mark.smoke
+def test_fleet_selftest_entrypoint():
+    """The tier-1/smoke gate in-process: the checked-in two-host shard
+    fixtures through the full merge with hand-computed asserts."""
+    assert fleet.selftest() == 0

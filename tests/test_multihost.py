@@ -173,10 +173,6 @@ def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     checkpoint to the exact oracle counts."""
     import json
     import os
-    import socket
-    import subprocess
-    import sys
-    from pathlib import Path
 
     corpus = (b"Hello World EveryOne\nWorld Good News\n"
               b"Good Morning Hello\n" * 40)
@@ -184,39 +180,15 @@ def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     path.write_bytes(corpus)
     ckpt = str(tmp_path / "g.ck.npz")
 
-    repo = Path(__file__).resolve().parent.parent
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
-    env["PYTHONPATH"] = str(repo)
-    worker = str(repo / "tests" / "global_worker.py")
-
-    def launch(crash_at: int):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        procs = [subprocess.Popen(
-            [sys.executable, worker, str(p), "2", str(port), str(path),
-             "256", "2", ckpt, str(crash_at)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True) for p in range(2)]
-        outs = []
-        try:
-            for p in procs:
-                outs.append(p.communicate(timeout=300))
-        finally:
-            for p in procs:
-                p.kill()
-        return procs, outs
-
     # Round 1: both processes crash (synchronously) before step 2; the
     # coordinator has checkpointed steps 1 and 2 by then.
-    procs, outs = launch(crash_at=2)
+    procs, outs = _launch_global_workers(path, ckpt, crash_at=2)
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 17, f"injection missing:\nrc={p.returncode}\n{err[-2000:]}"
     assert os.path.exists(ckpt), "no checkpoint written before the crash"
 
     # Round 2: fresh processes resume from the checkpoint and finish.
-    procs, outs = launch(crash_at=-1)
+    procs, outs = _launch_global_workers(path, ckpt, crash_at=-1)
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"resume failed:\n{err[-2000:]}"
     json_lines = [ln for out, _ in outs for ln in out.splitlines()
@@ -228,6 +200,157 @@ def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     assert got["distinct"] == len(expected)
     assert got["counts"] == sorted(expected.values())
     assert got["processes"] == 2 and got["devices"] == 4
+
+
+def _launch_global_workers(path, ckpt, crash_at, ledger=None,
+                           chunk_bytes=256):
+    """Spawn the 2-process run_job_global gloo harness (global_worker.py);
+    ``ledger`` attaches telemetry at a shared path (ISSUE 13)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = Path(__file__).resolve().parent.parent
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(repo)
+    worker = str(repo / "tests" / "global_worker.py")
+    argv = [sys.executable, worker, "PID", "2", str(port), str(path),
+            str(chunk_bytes), "2", str(ckpt), str(crash_at)]
+    if ledger is not None:
+        argv.append(ledger)
+    procs = [subprocess.Popen(argv[:2] + [str(p)] + argv[3:],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for p in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=300))
+    finally:
+        for p in procs:
+            p.kill()
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_run_job_global_multiprocess_writes_host_shards(tmp_path):
+    """ISSUE 13 tentpole, falsified on the real 2-process gloo harness: a
+    telemetered run_job_global leaves one host-stamped shard ledger per
+    process (one group record per retired group, the run-epoch clock on
+    run_start, a collective record, per-host run_end phases) next to the
+    coordinator's main file; obs/fleet.py merges the shards into a 2-host
+    view with a fleet_bottleneck verdict, byte-stable across merges."""
+    import json
+    import os
+
+    from mapreduce_tpu import obs
+    from mapreduce_tpu.obs import fleet
+
+    corpus = (b"Hello World EveryOne\nWorld Good News\n"
+              b"Good Morning Hello\n" * 40)
+    path = tmp_path / "fl.txt"
+    path.write_bytes(corpus)
+    ledger = str(tmp_path / "fl.jsonl")
+
+    procs, outs = _launch_global_workers(path, tmp_path / "fl.ck.npz",
+                                         crash_at=-1, ledger=ledger)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+
+    # The coordinator's main file: gated records, all host-0 stamped.
+    main = list(obs.read_ledger(ledger))
+    kinds = [r["kind"] for r in main]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert "collective" in kinds
+    assert all(r.get("host") == 0 for r in main), \
+        "only the coordinator writes the main file"
+    n_groups_main = kinds.count("group")
+    assert n_groups_main > 0
+
+    # One shard per process, every record host-stamped, exactly one group
+    # record per retired group (== the coordinator's count: SPMD lockstep),
+    # topology + clock on run_start, per-host run_end.
+    for h in (0, 1):
+        sp = obs.shard_path(ledger, h)
+        assert os.path.exists(sp), f"missing shard {sp}"
+        recs = list(obs.read_ledger(sp))
+        assert all(r.get("host") == h for r in recs)
+        start = next(r for r in recs if r["kind"] == "run_start")
+        assert start["ledger_version"] == obs.LEDGER_VERSION == 7
+        assert start["processes"] == 2 and start["local_devices"] == 2
+        assert set(start["clock"]) == {"wall", "mono"}
+        groups = [r for r in recs if r["kind"] == "group"]
+        assert len(groups) == n_groups_main
+        assert all(g.get("host_bytes") is not None for g in groups), \
+            "global-driver groups carry this host's staged bytes"
+        assert all(g["host_bytes"] <= g["group_bytes"] for g in groups)
+        assert [r["kind"] for r in recs].count("run_end") == 1
+        assert any(r["kind"] == "collective" for r in recs)
+
+    # Fleet merge: 2 hosts, aligned clocks, a verdict, stable bytes.
+    by_host = {h: fleet.read_jsonl(p)
+               for h, p in fleet.shard_paths(ledger).items()}
+    view = fleet.fleet_view(by_host)
+    assert view["hosts"] == [0, 1] and view["aligned"] is True
+    assert view["processes"] == 2
+    assert view["fleet_bottleneck"]["verdict"] in (
+        "straggler-bound", "collective-bound", "balanced")
+    assert view["per_host"]["0"]["groups"] == n_groups_main
+    # Both hosts staged half the shard rows of the same global batches.
+    assert view["per_host"]["0"]["group_bytes"] \
+        == view["per_host"]["1"]["group_bytes"]
+    twice = [json.dumps(fleet.fleet_view(by_host), sort_keys=True)
+             for _ in range(2)]
+    assert twice[0] == twice[1]
+
+
+@pytest.mark.slow
+def test_noncoordinator_failure_leaves_host_flight_dump(tmp_path):
+    """ISSUE 13 satellite bugfix: pre-v7 the coordinator-only write_gate
+    swallowed every non-coordinator flight dump.  An injected failure now
+    leaves a dump from EACH host at its own path — the non-coordinator's
+    at the host-suffixed one — plus a failure record in its shard."""
+    import json
+    import os
+
+    from mapreduce_tpu import obs
+
+    corpus = (b"Hello World EveryOne\nWorld Good News\n"
+              b"Good Morning Hello\n" * 40)
+    path = tmp_path / "flc.txt"
+    path.write_bytes(corpus)
+    ledger = str(tmp_path / "flc.jsonl")
+
+    procs, outs = _launch_global_workers(path, tmp_path / "flc.ck.npz",
+                                         crash_at=2, ledger=ledger)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 17, \
+            f"injection missing:\nrc={p.returncode}\n{err[-2000:]}"
+
+    # Coordinator keeps the classic path; host 1 dumps to its own file.
+    assert os.path.exists(ledger + ".flight.json")
+    h1_dump = obs.shard_flight_path(ledger, 1)
+    assert os.path.exists(h1_dump), \
+        "non-coordinator failure must dump on that host"
+    with open(h1_dump) as f:
+        dump = json.load(f)
+    assert "injected crash" in dump["context"]["error"]
+    assert dump["events"], "the ring must carry the host's events"
+    # The failure record lands in host 1's shard (the main file's copy
+    # stays coordinator-gated).
+    h1 = list(obs.read_ledger(obs.shard_path(ledger, 1)))
+    fails = [r for r in h1 if r["kind"] == "failure"]
+    assert len(fails) == 1 and fails[0]["host"] == 1
+    assert fails[0]["flight_dump"] == h1_dump
+    main_fails = [r for r in obs.read_ledger(ledger)
+                  if r["kind"] == "failure"]
+    assert all(r.get("host") == 0 for r in main_fails)
 
 
 @pytest.mark.slow

@@ -180,6 +180,18 @@ def main() -> int:
                 ("kernel-smoke", [sys.executable, "tools/kernel_smoke.py",
                                   "--geometry", "3"],
                  env),
+                # ISSUE 13 pod-scale obs proof next to the multichip
+                # dryrun: a 2-process gloo-CPU run_job_global leaves one
+                # ledger shard per host, merged by obs/fleet.py into the
+                # pid-per-host Perfetto trace + fleet_bottleneck verdict
+                # (straggler/collective/balanced) — the JSON line + trace
+                # land next to this window's bench rows, so the first
+                # live window documents the fleet-obs stack working where
+                # the numbers were taken.  CPU-hermetic (like the
+                # dryrun): a wedged relay can't hang it.
+                ("multichip-fleet-report",
+                 [sys.executable, "tools/fleet_report.py",
+                  "--out", args.out + ".fleet"], env),
                 # Defaults row = stable2 since round 5 (+5.9% measured).
                 ("bench-zipf", [sys.executable, "bench.py"], env),
                 # ISSUE 5 dispatch-window A/B: streamed ingest with the

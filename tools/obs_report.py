@@ -33,6 +33,14 @@ Reads the JSONL run ledger the executor writes under ``--ledger``
 ``--compare A.jsonl B.jsonl`` diffs two ledgers' phase shares, bound
 classifications, bottleneck verdicts and data-health dicts in one table —
 the render surface for A/B rows (pipeline/nopipeline, fused/split).
+``--run-id`` selects one run from an append-mode ledger (render and
+compare alike) instead of always the last completed one (ISSUE 13
+satellite).  When per-host shard files (``<ledger>.h*.jsonl``, ledger
+v7) sit next to the analyzed ledger, the report appends the **fleet
+section** — per-host busy/collective seconds, straggler skew with
+slowest-host attribution, the ``fleet_bottleneck`` verdict and
+host-imbalance flags (``mapreduce_tpu/obs/fleet.py``) — and
+``--compare`` gains the fleet rows.
 
 Deliberately jax-free and stdlib-only: a wedged TPU box, a laptop, or CI
 can all read the forensics of a run that happened somewhere else (the
@@ -101,6 +109,28 @@ def _timeline_mod():
 
 def _datahealth_mod():
     return _obs_mod("datahealth")
+
+
+def _fleet_mod():
+    return _obs_mod("fleet")
+
+
+def fleet_view_for(ledger_path: str, run_id=None):
+    """The fleet artifact for a ledger with ``<ledger>.h*.jsonl`` shard
+    files next to it (ISSUE 13), or None on single-host ledgers / when
+    the fleet module is unavailable — the report degrades to no fleet
+    section, never an error."""
+    fl = _fleet_mod()
+    if fl is None:
+        return None
+    try:
+        return fl.from_ledger(ledger_path, run_id)
+    except Exception:
+        return None
+
+
+def render_fleet(view: dict, out) -> None:
+    _fleet_mod().render(view, out)
 
 
 def read_ledger(path: str):
@@ -305,7 +335,8 @@ def analyze_run(records: list) -> dict:
               ("driver", "job", "devices", "chunk_bytes", "superstep",
                "backend", "map_impl", "combiner", "geometry",
                "geometry_spec", "merge_strategy", "input",
-               "retry", "ledger_version")} if start else None
+               "retry", "ledger_version", "host", "processes")} \
+        if start else None
     classification = classify(phases)
     # Measured timeline (ISSUE 7): present only when the run carries
     # `group` lifecycle records AND the reconstructor is loadable.
@@ -361,12 +392,24 @@ def analyze_run(records: list) -> dict:
 
 
 def analyze(path: str) -> list:
-    """All runs in a ledger file, in first-appearance order."""
+    """All run INSTANCES in a ledger file, in first-appearance order.
+
+    Instances, not just ids (ISSUE 13): the multi-host contract passes
+    one shared run_id to every process, and a crash+relaunch recovery
+    appends a second run under that id — every run_start opens a new
+    instance (the ``obs/fleet.py`` selection rule), so a crashed attempt
+    and its recovery analyze separately instead of fusing into a chimera
+    (first header + last run_end + combined steps)."""
     records = read_ledger(path)
-    by_run: dict = {}
+    by_run: list = []   # (run_id, records) per instance
+    current: dict = {}  # run_id -> index into by_run
     for r in records:
-        by_run.setdefault(r.get("run_id", "?"), []).append(r)
-    return [analyze_run(rs) for rs in by_run.values()]
+        rid = r.get("run_id", "?")
+        if r.get("kind") == "run_start" or rid not in current:
+            current[rid] = len(by_run)
+            by_run.append((rid, []))
+        by_run[current[rid]][1].append(r)
+    return [analyze_run(rs) for _, rs in by_run]
 
 
 def render_run(a: dict, out) -> None:
@@ -412,6 +455,14 @@ def render_run(a: dict, out) -> None:
         spec = (a["header"] or {}).get("geometry_spec")
         out.write(f"  geometry: {geom}"
                   + (f" {spec}" if spec else "") + "\n")
+    # Multi-host stamp (ISSUE 13, ledger v7): which host's view this run
+    # record stream is, and how many processes the fleet had — the shard
+    # files next to the ledger hold the other hosts' views.
+    procs = (a["header"] or {}).get("processes")
+    if procs not in (None, 1):
+        out.write(f"  fleet: host {(a['header'] or {}).get('host', '?')} "
+                  f"of {procs} processes (per-host shards: "
+                  "<ledger>.h<p>.jsonl)\n")
     p = a.get("pipeline")
     if p:
         out.write(f"  pipeline: inflight={p.get('inflight_groups')}  "
@@ -528,9 +579,17 @@ def _phase_shares(phases: dict) -> dict:
             if phases.get(k)}
 
 
-def _pick_run(runs: list) -> dict | None:
-    """The run a compare reads from one ledger: the LAST completed run
-    (the most recent measurement), else the last run at all."""
+def _pick_run(runs: list, run_id: str | None = None) -> dict | None:
+    """The run a compare reads from one ledger: ``run_id`` when the
+    caller selects one (ISSUE 13 satellite: an append-mode ledger holds
+    many runs — bench keys on run_id, humans get the same selector),
+    else the LAST completed run (the most recent measurement), else the
+    last run at all.  An explicit id picks its LAST instance — the same
+    rule ``obs/fleet.py`` applies, so a compare's phase rows and fleet
+    rows describe the same execution."""
+    if run_id is not None:
+        matches = [a for a in runs if a.get("run_id") == run_id]
+        return matches[-1] if matches else None
     done = [a for a in runs if a.get("completed")]
     pool = done or runs
     return pool[-1] if pool else None
@@ -600,16 +659,43 @@ def compare_runs(a: dict, b: dict) -> list:
     return rows
 
 
-def compare(path_a: str, path_b: str, out, as_json: bool = False) -> int:
+def compare(path_a: str, path_b: str, out, as_json: bool = False,
+            run_id: str | None = None) -> int:
     """Diff two ledgers (phase shares, verdicts, data health) in one
-    table; see ``compare_runs``."""
-    a = _pick_run(analyze(path_a))
-    b = _pick_run(analyze(path_b))
+    table; see ``compare_runs``.  ``run_id`` selects that run on both
+    sides instead of each side's last completed one."""
+    a = _pick_run(analyze(path_a), run_id)
+    b = _pick_run(analyze(path_b), run_id)
     if a is None or b is None:
         print("compare: no runs found in "
-              f"{path_a if a is None else path_b}", file=sys.stderr)
+              f"{path_a if a is None else path_b}"
+              + (f" (run_id {run_id})" if run_id else ""), file=sys.stderr)
         return 1
     rows = compare_runs(a, b)
+    # Fleet rows (ISSUE 13): when either side is a sharded multi-host
+    # ledger, the A/B table also answers which arm's FLEET was bound by
+    # what, and by how much.  Keyed on the PICKED run's id, so the fleet
+    # rows always describe the same run as the phase/verdict rows above
+    # (an append-mode ledger's last completed run need not be the
+    # shards' last run).
+    fa = fleet_view_for(path_a, run_id or a.get("run_id"))
+    fb = fleet_view_for(path_b, run_id or b.get("run_id"))
+    if fa or fb:
+        bna = (fa or {}).get("fleet_bottleneck") or {}
+        bnb = (fb or {}).get("fleet_bottleneck") or {}
+        rows.append(["fleet verdict", str(bna.get("verdict", "-")),
+                     str(bnb.get("verdict", "-")), ""])
+        va, vb = bna.get("projected_saving_s"), bnb.get("projected_saving_s")
+        rows.append(["fleet saving_s",
+                     f"{va:.3f}" if isinstance(va, (int, float)) else "-",
+                     f"{vb:.3f}" if isinstance(vb, (int, float)) else "-",
+                     f"{vb - va:.3f}" if isinstance(va, (int, float))
+                     and isinstance(vb, (int, float)) else ""])
+        rows.append(["fleet imbalance",
+                     str(((fa or {}).get("imbalance") or {})
+                         .get("verdict", "-")),
+                     str(((fb or {}).get("imbalance") or {})
+                         .get("verdict", "-")), ""])
     if as_json:
         out.write(json.dumps({
             "a": {"ledger": path_a, "run_id": a.get("run_id")},
@@ -660,7 +746,7 @@ def selftest() -> int:
     ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 7, f"fixture holds seven runs, got {len(runs)}"
+    assert len(runs) == 8, f"fixture holds eight runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -755,7 +841,18 @@ def selftest() -> int:
     h8flag = next(f for f in h8["data_health"]["flags"]
                   if f["flag"] == "skew-hot")
     assert "absorbing 70.0%" in h8flag["detail"], h8flag
-    # Run 7 in file order (ISSUE 8): a spill-heavy pallas run carrying
+    # Run 7 in file order (ISSUE 13): a ledger-v7 two-host run's
+    # coordinator view — host-stamped records, the processes/clock
+    # topology in run_start, a `collective` record.  The header must
+    # surface the stamp, and the collective record must pass through
+    # every consumer (it sits BEFORE fixture05 so the spill run stays
+    # the --compare pick below).
+    p9 = runs[6]
+    assert p9["header"]["ledger_version"] == 7, p9["header"]
+    assert p9["header"]["host"] == 0 and p9["header"]["processes"] == 2, \
+        p9["header"]
+    assert p9["completed"] and p9["timeline"]["groups"] == 2, p9["timeline"]
+    # Run 8 in file order (ISSUE 8): a spill-heavy pallas run carrying
     # per-group `data` dicts and the per-run `data` record.  Checked
     # against the arithmetic done by hand on the fixture: 3 of 6 chunks
     # took the full-resolution fallback (fallback_frac 0.5 > the 5%
@@ -764,7 +861,7 @@ def selftest() -> int:
     # the 5% gate), and 20 distinct keys spilled — so the verdict is
     # spill-bound with rescue-heavy and table-pressure riding along, and
     # nothing else.
-    e = runs[6]
+    e = runs[7]
     assert e["header"]["ledger_version"] == 3, e["header"]
     assert e["data"] is not None and e["data"]["fallback_chunks"] == 3
     eh = e["data_health"]
@@ -782,8 +879,14 @@ def selftest() -> int:
     egroups = [r for r in read_ledger(ledger)
                if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
     assert all("data" in g for g in egroups), egroups
-    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6)), \
+    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6, 7)), \
         "runs without a tune record must carry None"
+    # --run-id (ISSUE 13 satellite): an append-mode ledger's compare pick
+    # honors an explicit selector instead of always the last completed
+    # run, and an absent id is an honest miss, not a silent fallback.
+    assert _pick_run(runs, "fixture01")["run_id"] == "fixture01"
+    assert _pick_run(runs, "no-such-run") is None
+    assert _pick_run(runs)["run_id"] == "fixture05"
     # The clean A/B counterpart (mini_ledger_b): uniform corpus, no
     # fallbacks, top key at 24/60000 = 0.04% — verdict clean; the pair is
     # the checked-in proof that a hot-key corpus and a uniform one are
@@ -813,8 +916,10 @@ def selftest() -> int:
     render_run(g7, buf)
     render_run(h8, buf)
     render_run(f6, buf)
+    render_run(p9, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
+    assert "fleet: host 0 of 2 processes" in body, body
     assert ("combiner: hot-cache — 42000 hits (70.00% of tokens), "
             "40000 sort rows deleted, 2000 flushes (150 cold)") in body, body
     assert "ANOMALY step-time spike" in body
@@ -854,6 +959,27 @@ def selftest() -> int:
     assert cobj["a"]["run_id"] == "fixture05" \
         and cobj["b"]["run_id"] == "fixture06", cobj
     assert any(r[0] == "data verdict" for r in cobj["rows"]), cobj["rows"]
+    # Fleet section (ISSUE 13): the two-host shard fixtures next to
+    # fleet_ledger.jsonl merge into the cross-host view — straggler
+    # verdict + host-imbalance flag rendered under the run report — and
+    # the --compare table gains the fleet rows when either side shards.
+    fview = fleet_view_for(os.path.join(fdir, "fleet_ledger.jsonl"))
+    assert fview is not None and fview["hosts"] == [0, 1], fview
+    assert fview["fleet_bottleneck"]["verdict"] == "straggler-bound", fview
+    assert fview["straggler"]["total_skew_s"] == 2.0, fview["straggler"]
+    assert fview["imbalance"]["verdict"] == "host-imbalance", fview
+    fbuf = io.StringIO()
+    render_fleet(fview, fbuf)
+    fbody = fbuf.getvalue()
+    assert "fleet bottleneck: straggler-bound" in fbody, fbody
+    assert "FLEET host-imbalance" in fbody, fbody
+    assert fleet_view_for(ledger) is None, \
+        "a shardless ledger must degrade to no fleet section"
+    fcmp = io.StringIO()
+    assert compare(os.path.join(fdir, "fleet_ledger.jsonl"), ledger_b,
+                   fcmp) == 0
+    ftext = fcmp.getvalue()
+    assert "fleet verdict" in ftext and "straggler-bound" in ftext, ftext
     # Ledger forward compat (ISSUE 7 satellite): a future-versioned ledger
     # with unknown kinds and unknown fields must analyze and render
     # without error, and still surface the facts it does understand —
@@ -885,7 +1011,8 @@ def selftest() -> int:
           f"timeline bottleneck={bn['resource']}, "
           f"data health={eh['verdict']}, tune rule={tn['rule']}, "
           f"geometry={f6['header']['geometry']}, "
-          "compare ok, future-ledger ok)")
+          f"fleet={fview['fleet_bottleneck']['verdict']}, "
+          "run-id selector ok, compare ok, future-ledger ok)")
     return 0
 
 
@@ -898,10 +1025,15 @@ def main(argv=None) -> int:
                          "<ledger>.flight.json that exists)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable analysis instead")
+    ap.add_argument("--run-id", default=None,
+                    help="select one run from an append-mode ledger "
+                         "(default: render every run; --compare defaults "
+                         "to each side's last completed run)")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"), default=None,
                     help="diff two ledgers' phase shares, bound/bottleneck "
                          "verdicts and data-health dicts in one table "
-                         "(each side uses its last completed run)")
+                         "(each side uses its last completed run unless "
+                         "--run-id selects one)")
     ap.add_argument("--selftest", action="store_true",
                     help="run against the checked-in fixtures and exit")
     args = ap.parse_args(argv)
@@ -909,20 +1041,33 @@ def main(argv=None) -> int:
         return selftest()
     if args.compare:
         return compare(args.compare[0], args.compare[1], sys.stdout,
-                       as_json=args.json)
+                       as_json=args.json, run_id=args.run_id)
     if not args.ledger and not args.flight:
         ap.error("a ledger path (or --flight, --compare, or --selftest) "
                  "is required")
     runs = analyze(args.ledger) if args.ledger else []
+    if args.run_id is not None and args.ledger:
+        # Flight-only invocations (--flight without a ledger) skip the
+        # filter: there are no runs to select from.
+        runs = [a for a in runs if a.get("run_id") == args.run_id]
+        if not runs:
+            print(f"no run {args.run_id!r} in {args.ledger}",
+                  file=sys.stderr)
+            return 1
+    # Fleet section (ISSUE 13): a multi-host ledger's shard files merge
+    # into the cross-host view right under the per-run reports.
+    fleet = fleet_view_for(args.ledger, args.run_id) if args.ledger else None
     flight = args.flight
     if flight is None and args.ledger \
             and os.path.exists(args.ledger + ".flight.json"):
         flight = args.ledger + ".flight.json"
     if args.json:
-        print(json.dumps({"runs": runs, "flight": flight}))
+        print(json.dumps({"runs": runs, "flight": flight, "fleet": fleet}))
         return 0
     for a in runs:
         render_run(a, sys.stdout)
+    if fleet:
+        render_fleet(fleet, sys.stdout)
     if flight:
         render_flight(flight, sys.stdout)
     if not runs and not flight:

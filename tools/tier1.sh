@@ -28,6 +28,11 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mapreduce_tpu.analysis --all-m
 # A/B diff are certified before a single test runs, in seconds.
 timeout -k 5 60 python tools/obs_report.py --selftest || { echo "TIER1: obs_report selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_export selftest FAILED"; exit 1; }
+# Fleet-merge gate (ISSUE 13): the two-host shard fixtures through the
+# clock-aligned merge — per-superstep skew and the straggler/collective
+# fleet_bottleneck verdict asserted against hand arithmetic, merge
+# byte-stability, the pid-per-host trace — jax-free, seconds.
+timeout -k 5 60 python mapreduce_tpu/obs/fleet.py --selftest || { echo "TIER1: fleet selftest FAILED"; exit 1; }
 # Autotuner gate (ISSUE 10): the rule-table/search/oscillation-guard walk
 # over the checked-in tuner fixtures, hand-computed targets asserted —
 # also jax-free, seconds.

@@ -131,6 +131,23 @@ def export(ledger_path: str, run_id=None):
                                                                run_id)
 
 
+def export_fleet(ledger_path: str, run_id=None):
+    """Multi-host ledger -> (pid-per-host fleet trace or None, fleet
+    artifact or None) from the ``<ledger>.h*.jsonl`` shards next to it
+    (ISSUE 13).  Uses the jax-free ``obs/fleet.py`` via the same by-path
+    loader as the timeline."""
+    fl = obs_report._fleet_mod()
+    if fl is None:
+        raise RuntimeError("fleet module unavailable (mapreduce_tpu/obs/"
+                           "fleet.py not found and package not installed)")
+    paths = fl.shard_paths(ledger_path)
+    if not paths:
+        return None, None
+    by_host = {h: fl.read_jsonl(p) for h, p in paths.items()}
+    return fl.to_chrome_trace(by_host, run_id), fl.fleet_view(by_host,
+                                                              run_id)
+
+
 # -- selftest ----------------------------------------------------------------
 
 def selftest() -> int:
@@ -200,9 +217,29 @@ def selftest() -> int:
     ftrace, fart = export(future)
     assert fart is not None and fart["groups"] >= 1, fart
     assert not validate_trace(ftrace)
+    # Fleet export (ISSUE 13): the two-host shard fixtures render as one
+    # schema-valid trace with one pid per HOST (lanes become tids inside
+    # it) and the fleet verdict in otherData; a shardless ledger declines
+    # with None instead of erroring.
+    fleet_trace, fleet_art = export_fleet(
+        os.path.join(HERE, "fixtures", "fleet_ledger.jsonl"))
+    assert fleet_trace is not None and fleet_art["hosts"] == [0, 1]
+    ferrs = validate_trace(fleet_trace)
+    assert not ferrs, f"fleet trace schema errors: {ferrs}"
+    fnames = sorted(e["args"]["name"] for e in fleet_trace["traceEvents"]
+                    if e["ph"] == "M" and e["name"] == "process_name")
+    assert fnames == ["host 0", "host 1"], fnames
+    assert fleet_trace["otherData"]["fleet_bottleneck"]["verdict"] \
+        == "straggler-bound"
+    fslices = [e for e in fleet_trace["traceEvents"] if e["ph"] == "X"]
+    assert any(e["name"].startswith("collective") for e in fslices)
+    assert export_fleet(ledger) == (None, None), \
+        "a shardless ledger has no fleet trace"
     print(f"trace_export selftest ok ({len(slices)} slices, "
           f"{len(starts)} flows, {len(gaps)} idle markers, "
-          f"{len(dmarks)} data markers, bottleneck={bn['resource']})")
+          f"{len(dmarks)} data markers, bottleneck={bn['resource']}, "
+          f"fleet trace {len(fslices)} slices over "
+          f"{len(fleet_art['hosts'])} hosts)")
     return 0
 
 
@@ -216,6 +253,10 @@ def main(argv=None) -> int:
     ap.add_argument("--run", default=None,
                     help="run_id to export (default: first run with "
                          "group records)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="export the multi-host fleet trace instead: merge "
+                         "the <ledger>.h*.jsonl shards, one Perfetto pid "
+                         "per host (default out: <ledger>.fleet.trace.json)")
     ap.add_argument("--stdout", action="store_true",
                     help="write the trace JSON to stdout instead of a file")
     ap.add_argument("--selftest", action="store_true",
@@ -225,12 +266,19 @@ def main(argv=None) -> int:
         return selftest()
     if not args.ledger:
         ap.error("a ledger path (or --selftest) is required")
-    trace, art = export(args.ledger, args.run)
-    if trace is None:
-        print("no group records found (pre-ISSUE-7 ledger, or the run "
-              "never retired a group) — nothing to export",
-              file=sys.stderr)
-        return 1
+    if args.fleet:
+        trace, art = export_fleet(args.ledger, args.run)
+        if trace is None:
+            print(f"no shard files ({args.ledger}.h*.jsonl) found — not a "
+                  "multi-host ledger?", file=sys.stderr)
+            return 1
+    else:
+        trace, art = export(args.ledger, args.run)
+        if trace is None:
+            print("no group records found (pre-ISSUE-7 ledger, or the run "
+                  "never retired a group) — nothing to export",
+                  file=sys.stderr)
+            return 1
     errs = validate_trace(trace)
     if errs:  # a bug here must fail loudly, not ship a broken trace
         for e in errs:
@@ -239,6 +287,16 @@ def main(argv=None) -> int:
     if args.stdout:
         json.dump(trace, sys.stdout)
         print()
+    elif args.fleet:
+        out = args.out or args.ledger + ".fleet.trace.json"
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        bn = art["fleet_bottleneck"]
+        print(f"wrote {out}: {len(art['hosts'])} hosts over "
+              f"{art['span_s']:.3f}s, skew "
+              f"{art['straggler']['total_skew_s']:.3f}s, "
+              f"fleet bottleneck {bn['verdict']} (projected saving "
+              f"{bn['projected_saving_s']:.3f}s) — open in ui.perfetto.dev")
     else:
         out = args.out or args.ledger + ".trace.json"
         with open(out, "w", encoding="utf-8") as f:
