@@ -354,11 +354,14 @@ def _write_last_good(result: dict) -> None:
     # BENCH_TRACE only gates whether the timed pass's ledger is ALSO
     # rendered to a trace file after the fact — pure post-processing of
     # records already written, measurement-neutral like BENCH_LEDGER.
+    # BENCH_HISTORY (ISSUE 14) only redirects where the run-history
+    # warehouse ingests the already-written ledger — post-processing
+    # again, and the warehouse never feeds back into LAST_GOOD.
     harness_only = {"BENCH_WATCHDOG_S", "BENCH_PROBE",
                     "BENCH_PROBE_BUDGET_S", "BENCH_COMPILE_CACHE",
                     "BENCH_LEDGER", "BENCH_RETRY_BUDGET_S",
                     "BENCH_PROBE_TIMEOUT_S", "BENCH_FORCE_LAST_GOOD",
-                    "BENCH_TRACE"}
+                    "BENCH_TRACE", "BENCH_HISTORY"}
     if result.get("input") != "synthetic-zipf":
         _log_refused(f"non-headline corpus {result.get('input')!r} "
                      "(A/B evidence belongs in BENCHMARKS.md)")
@@ -842,6 +845,44 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001 — advisory only
                 print(f"[bench] data summary skipped ({e!r})",
                       file=sys.stderr)
+            # Run-history registration (ISSUE 14 satellite BUGFIX): an
+            # append-mode BENCH_LEDGER accumulates many timed passes but
+            # nothing ever ingested them — every pass now lands in the
+            # run-history warehouse (BENCH_HISTORY overrides the index
+            # dir, 0 disables; default next to the ledger), and the row
+            # carries this pass's config key + the key group's drift
+            # verdict (regressing/improving/steady/config-drift).
+            # Harness-neutral and advisory: post-processing of records
+            # already on disk, never measurement-altering, and
+            # LAST_GOOD is untouched (the value-aware ledger stays the
+            # regression gate; the warehouse is the longitudinal view).
+            hist_env = os.environ.get("BENCH_HISTORY", "")
+            if hist_env != "0":
+                try:
+                    from mapreduce_tpu.obs import history as history_mod
+
+                    hdir = hist_env or (streamed_ledger + ".history")
+                    index = history_mod.ingest([streamed_ledger], hdir)
+                    mine = [r for r in index["runs"].values()
+                            if r.get("run_id") == tel.run_id]
+                    row = max(mine, key=lambda r: r.get("instance") or 0) \
+                        if mine else None
+                    drift = history_mod.classify_drift(
+                        history_mod.group_rows(index, row["group"])) \
+                        if row else None
+                    result["history"] = {
+                        "index": history_mod.index_path(hdir),
+                        "runs": len(index.get("runs", {})),
+                        "key": row.get("key") if row else None,
+                        "drift": (drift or {}).get("verdict"),
+                    }
+                    _log("history: registered run under "
+                         f"{result['history']['key']} "
+                         f"({result['history']['runs']} runs indexed, "
+                         f"drift={result['history']['drift']})", wall0)
+                except Exception as e:  # noqa: BLE001 — advisory only
+                    print(f"[bench] history registration skipped ({e!r})",
+                          file=sys.stderr)
         # Registry DELTA over the timed streamed pass (the registry is
         # process-global, so an absolute snapshot would fold in the
         # headline + warm-up activity): steps/dispatches/prefetches and

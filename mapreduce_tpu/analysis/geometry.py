@@ -44,7 +44,6 @@ contract.
 from __future__ import annotations
 
 import dataclasses
-import json
 from typing import Iterable, Optional
 
 from mapreduce_tpu.config import (DEFAULT_GEOMETRY, GEOMETRY_PRESETS,
@@ -290,26 +289,21 @@ def resolve_auto(profile_path: str, family: str = "wordcount"):
     config carries a non-default geometry decides — its label (preset
     round-trip) or spec dict (Config accepts both).  No profile, no
     geometry entry, or an unreadable file resolves to 'default' — the
-    combiner='auto' degrade-to-off contract."""
-    try:
-        with open(profile_path, encoding="utf-8") as f:
-            profiles = json.load(f).get("profiles", {})
-    except (OSError, ValueError):
-        return "default"
-    mine = {key: entry for key, entry in profiles.items()
-            if isinstance(entry, dict) and key.startswith(family)}
-    for key, entry in sorted(mine.items(),
-                             key=lambda kv: kv[1].get("recorded_at") or "",
-                             reverse=True):
-        geom = (entry.get("config") or {}).get("geometry")
-        if geom in (None, "default"):
-            continue
-        if isinstance(geom, str) and geom in GEOMETRY_PRESETS:
-            return geom
-        if isinstance(geom, dict):
-            try:
-                Geometry(**geom)
-            except (TypeError, ValueError):
-                continue  # future-shaped profile: skip, never crash
-            return geom
-    return "default"
+    combiner='auto' degrade-to-off contract.
+
+    The read itself lives in the run-history warehouse now (ISSUE 14:
+    ``obs/history.resolve_prior`` is the one place prior-run questions
+    are answered); this wrapper supplies the Config-side validation the
+    jax-free warehouse cannot import."""
+    from mapreduce_tpu.obs import history
+
+    def _valid_spec(spec: dict) -> bool:
+        try:
+            Geometry(**spec)
+        except (TypeError, ValueError):
+            return False  # future-shaped profile: skip, never crash
+        return True
+
+    return history.resolve_prior(
+        profile_path=profile_path, family=family,
+        presets=set(GEOMETRY_PRESETS), geometry_ok=_valid_spec)["geometry"]

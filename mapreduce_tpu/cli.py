@@ -585,7 +585,10 @@ def main(argv: list[str] | None = None) -> int:
         # most recent data-health verdict decides; no ledger history
         # resolves to 'off').  The resolved mode is stamped into this
         # run's own run_start/data records, so a chain of 'auto' runs is
-        # a self-documenting feedback loop.
+        # a self-documenting feedback loop.  The read goes through the
+        # run-history warehouse's resolve_prior (ISSUE 14: the one place
+        # "what did runs like this one do before" is answered) — same
+        # outcome as the old datahealth.resolve_combiner read.
         import dataclasses as _dc
 
         records = []
@@ -593,9 +596,9 @@ def main(argv: list[str] | None = None) -> int:
             from mapreduce_tpu.obs import read_ledger
 
             records = read_ledger(args.ledger)
-        from mapreduce_tpu.obs import datahealth
+        from mapreduce_tpu.obs import history
 
-        resolved = datahealth.resolve_combiner(records)
+        resolved = history.resolve_prior(records=records)["combiner"]
         # An 'off' resolution also drops any explicit cache sizing: the
         # slots knob only exists with the cache (Config validates that).
         config = _dc.replace(

@@ -26,13 +26,20 @@ regions — all still used, now fed through one layer):
   timeline: per-host lanes, per-superstep straggler skew, collective
   accounting, and the ``fleet_bottleneck`` verdict (straggler-bound /
   collective-bound / balanced);
+* :mod:`.history` — the run-history warehouse (ISSUE 14): ingest many
+  (possibly sharded, append-mode) ledgers into an on-disk index of
+  per-run digests keyed by config, longitudinal series/streak queries,
+  the ``regressing``/``improving``/``steady``/``config-drift`` drift
+  classifier, and :func:`.history.resolve_prior` — the one prior-run
+  read ``combiner='auto'``, ``geometry='auto'`` and the autotuner's
+  ``derive_signals`` resolve through;
 * :mod:`.telemetry` — the facade the executor takes as ONE optional arg.
 
 Reporting: ``tools/obs_report.py`` renders a ledger/flight pair into a run
 summary with anomaly flags.  Schemas: ``docs/observability.md``.
 """
 
-from mapreduce_tpu.obs import datahealth, fleet, timeline
+from mapreduce_tpu.obs import datahealth, fleet, history, timeline
 from mapreduce_tpu.obs.flight import FlightRecorder, summarize_state
 from mapreduce_tpu.obs.ledger import (LEDGER_VERSION, RunLedger, read_ledger,
                                       shard_flight_path, shard_path)
@@ -44,6 +51,7 @@ from mapreduce_tpu.obs.telemetry import (Telemetry, device_memory_stats,
 __all__ = [
     "FlightRecorder", "LEDGER_VERSION", "MetricsRegistry", "RunLedger",
     "Telemetry", "datahealth", "device_memory_stats", "fleet",
-    "get_registry", "maybe", "read_ledger", "shard_flight_path",
-    "shard_path", "span", "summarize_state", "timeline",
+    "get_registry", "history", "maybe", "read_ledger",
+    "shard_flight_path", "shard_path", "span", "summarize_state",
+    "timeline",
 ]

@@ -249,8 +249,11 @@ def resolve_combiner(records: Iterable[dict]) -> str:
     (ISSUE 11): the most recent ``data`` record's verdict decides —
     skew-hot flips the hot-key combiner on, anything else (including no
     history at all) stays off.  The same flip the autotuner's
-    ``skew-hot -> enable-combiner`` rule proposes, packaged for drivers
-    that resolve BEFORE compiling (the CLI, service warm-starts)."""
+    ``skew-hot -> enable-combiner`` rule proposes.  NOTE (ISSUE 14):
+    this is the jax-free PRIMITIVE; drivers resolve through
+    ``obs/history.resolve_prior(records=...)["combiner"]`` — the one
+    prior-run read — which reproduces this function bit-for-bit (the
+    parity is asserted in the history selftest)."""
     rec = latest_data_record(records)
     if rec is None:
         return "off"

@@ -146,35 +146,57 @@ def load_shards(paths: Iterable[str]) -> Dict[int, List[dict]]:
     return out
 
 
+def split_instances(records: Iterable[dict]) \
+        -> List[Tuple[Optional[str], int, List[dict]]]:
+    """An append-mode record stream -> ``[(run_id, instance, records)]``
+    in first-appearance order — the CANONICAL run-instance splitter
+    (``obs/history.py``, ``tools/obs_report.py`` and ``tools/
+    obswatch.py`` all consume this one rule).
+
+    Instances, not just ids: the documented multi-host contract passes
+    the SAME ``run_id`` to every process, ledger files are append-mode,
+    and a crash+relaunch recovery appends a second run under that id —
+    every ``run_start`` opens a NEW instance, so the crashed attempt and
+    its recovery never fuse into one corrupt view (a file's records are
+    sequential: one writer, runs never interleave)."""
+    out: List[Tuple[Optional[str], int, List[dict]]] = []
+    current: Dict = {}  # run_id -> index of its open instance
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        rid = r.get("run_id")
+        if r.get("kind") == "run_start" or rid not in current:
+            current[rid] = len(out)
+            out.append((rid, sum(1 for x in out if x[0] == rid), []))
+        out[current[rid]][2].append(r)
+    return out
+
+
+def run_status(completed: bool, failures: int) -> str:
+    """The ONE completed/crashed/in-flight rule (a ``run_end`` record =
+    completed; a ``failure`` record with no ``run_end`` after = crashed;
+    neither = still going, or the process died without the failure path
+    running).  ``obs_report --list-runs``, ``tools/obswatch.py`` and the
+    ``obs/history.py`` digests all classify through this predicate."""
+    if completed:
+        return "completed"
+    return "crashed" if failures else "in-flight"
+
+
 def select_run(records: List[dict],
                run_id: Optional[str] = None) -> Tuple[Optional[str],
                                                       List[dict]]:
     """One shard's records of one RUN INSTANCE: ``run_id`` when given
-    (its last instance), else the shard's last instance overall.
-
-    Instances, not just ids: the documented multi-host contract passes
-    the SAME ``run_id`` to every process, shard files are append-mode,
-    and a crash+relaunch recovery appends a second run under that id —
-    every ``run_start`` opens a NEW instance, so the crashed attempt and
-    its recovery never fuse into one corrupt fleet view (a shard's
-    records are sequential: one writer, runs never interleave)."""
-    runs: Dict = {}      # (run_id, instance_ordinal) -> records
-    order: List = []     # instance keys in first-appearance order
-    current: Dict = {}   # run_id -> its open instance key
-    for r in records:
-        rid = r.get("run_id")
-        if r.get("kind") == "run_start" or rid not in current:
-            key = (rid, sum(1 for k in order if k[0] == rid))
-            current[rid] = key
-            runs[key] = []
-            order.append(key)
-        runs[current[rid]].append(r)
+    (its last instance), else the shard's last instance overall —
+    derived from :func:`split_instances`."""
+    runs = split_instances(records)
     if run_id is not None:
-        keys = [k for k in order if k[0] == run_id]
-        return run_id, (runs[keys[-1]] if keys else [])
-    if not order:
+        mine = [r for r in runs if r[0] == run_id]
+        return run_id, (mine[-1][2] if mine else [])
+    if not runs:
         return None, []
-    return order[-1][0], runs[order[-1]]
+    rid, _, recs = runs[-1]
+    return rid, recs
 
 
 def clock_offset(records: Iterable[dict]) -> Optional[float]:

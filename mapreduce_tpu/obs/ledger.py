@@ -50,6 +50,14 @@ collective     one per run (ISSUE 13, inside the reduce phase): the
                ended_at) + merge strategy — the raw material of the
                fleet timeline's ``collective`` lane (strategy *builds*
                stay registry metrics: they happen at trace time)
+progress       the live-run heartbeat (ISSUE 14, ledger v8): emitted on
+               a wall-clock cadence from the dispatch/retire points —
+               stream cursor + total bytes + completion fraction,
+               groups dispatched/retired, current in-flight depth,
+               throughput-so-far, ETA from the byte cursor.  Host-side
+               only (no device work, no memory-stat sampling) and
+               flushed per record, so ``tools/obswatch.py`` can tail a
+               run in flight
 checkpoint     step, cursor_bytes, save_s, path
 retry          step, attempt, error
 failure        step, cursor_bytes, error, flight-dump path (if written)
@@ -104,8 +112,15 @@ from typing import Iterator, Optional
 #: ``host`` process-index stamp, run_start the ``processes``/
 #: ``local_devices`` topology + the ``clock`` {wall, mono} alignment
 #: pair, every process writes its own ``<ledger>.h<p>.jsonl`` shard, and
-#: the new per-run ``collective`` record times the collective finish.
-LEDGER_VERSION = 7
+#: the new per-run ``collective`` record times the collective finish;
+#: 8 = live run watching (ISSUE 14): the executor's telemetry emits a
+#: periodic ``progress`` heartbeat record (wall-clock cadence, host-side
+#: only: stream cursor + total bytes, groups dispatched/retired, current
+#: in-flight depth, throughput-so-far and the ETA derived from the byte
+#: cursor), flushed like every record so ``tools/obswatch.py`` can tail
+#: a run that has not ended — and ``obs/history.py`` can digest crashed
+#: runs up to their last heartbeat.
+LEDGER_VERSION = 8
 
 
 def shard_path(path: str, process_index: int) -> str:

@@ -110,6 +110,13 @@ def device_memory_stats() -> dict:
     return out
 
 
+#: Default wall-clock seconds between ``progress`` heartbeat records
+#: (ISSUE 14, ledger v8).  Coarse on purpose: a tailer wants a fresh line
+#: every few seconds, and anything finer just burns ledger bytes — the
+#: not-due path is one monotonic read + compare (the <1 ms bound).
+DEFAULT_PROGRESS_EVERY_S = 5.0
+
+
 class Telemetry:
     """One handle over the three telemetry planes.  See module docstring."""
 
@@ -118,7 +125,8 @@ class Telemetry:
                  ledger: Optional[ledger_mod.RunLedger] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  flight_path: Optional[str] = None,
-                 sample_device_stats: bool = True):
+                 sample_device_stats: bool = True,
+                 progress_every_s: float = DEFAULT_PROGRESS_EVERY_S):
         self.enabled = enabled
         self.registry = registry if registry is not None \
             else registry_mod.get_registry()
@@ -143,6 +151,11 @@ class Telemetry:
         # run, so callers that never see the RunResult (the CLI's
         # count_file path) can still surface the recommendation.
         self.last_tune: Optional[dict] = None
+        # Live-run heartbeat state (ISSUE 14, ledger v8): the wall-clock
+        # cadence gate and the stream-start baseline ETA math reads from.
+        self.progress_every_s = float(progress_every_s)
+        self._last_progress_t: Optional[float] = None
+        self._progress_t0: Optional[float] = None
         self._last_phases: dict = {}
         self._last_record_t: Optional[float] = None
         self._pending_compiles: list = []
@@ -159,16 +172,21 @@ class Telemetry:
                registry: Optional[registry_mod.MetricsRegistry] = None,
                flight_capacity: int = flight_mod.DEFAULT_CAPACITY,
                flight_path: Optional[str] = None,
-               run_id: Optional[str] = None) -> "Telemetry":
+               run_id: Optional[str] = None,
+               progress_every_s: float = DEFAULT_PROGRESS_EVERY_S) \
+            -> "Telemetry":
         """Full telemetry.  ``flight_path`` defaults next to the ledger
-        (``<ledger>.flight.json``) so one flag leaves both artifacts."""
+        (``<ledger>.flight.json``) so one flag leaves both artifacts.
+        ``progress_every_s`` sets the live-run heartbeat cadence
+        (ISSUE 14; 0 emits at every opportunity — test/tail-demo use)."""
         rid = run_id or uuid.uuid4().hex[:12]
         ledger = ledger_mod.RunLedger(ledger_path, rid) if ledger_path else None
         if flight_path is None and ledger_path:
             flight_path = ledger_path + ".flight.json"
         return cls(enabled=True, registry=registry, ledger=ledger,
                    flight=flight_mod.FlightRecorder(flight_capacity),
-                   flight_path=flight_path)
+                   flight_path=flight_path,
+                   progress_every_s=progress_every_s)
 
     _DISABLED: "Optional[Telemetry]" = None
 
@@ -322,6 +340,58 @@ class Telemetry:
         if compiles:
             rec["compile_events"] = compiles
         self.ledger_write("step", write=write, **rec)
+
+    def progress(self, *, step: int, cursor_bytes: int, streamed_bytes: int,
+                 total_bytes: Optional[int] = None,
+                 groups_dispatched: Optional[int] = None,
+                 groups_retired: Optional[int] = None,
+                 inflight_depth: Optional[int] = None,
+                 write: bool = True, force: bool = False) -> bool:
+        """The live-run heartbeat (ISSUE 14, ledger v8): one ``progress``
+        record per :attr:`progress_every_s` of wall clock — the stream
+        cursor, completion fraction, groups dispatched/retired, current
+        in-flight depth, throughput-so-far, and the ETA derived from the
+        byte cursor.  Pure host-side bookkeeping: no device wait, no
+        memory-stat sampling, and the not-due path is one monotonic read
+        + compare, so the dispatch loop can call it per group for free
+        (the <1 ms emission bound extends the PR-7/8 overhead bound).
+        Flushed like every ledger record, so ``tools/obswatch.py`` sees
+        it while the run is still in flight.  Returns True when a record
+        was written; always False with no ledger/shard attached (there
+        is nothing to tail)."""
+        if not self.enabled or (self.ledger is None and self.shard is None):
+            return False
+        now = time.monotonic()
+        if self._progress_t0 is None:
+            self._progress_t0 = now
+        if not force and self._last_progress_t is not None \
+                and now - self._last_progress_t < self.progress_every_s:
+            return False
+        self._last_progress_t = now
+        elapsed = now - self._progress_t0
+        rec: dict[str, Any] = {"step": int(step),
+                               "cursor_bytes": int(cursor_bytes),
+                               "streamed_bytes": int(streamed_bytes),
+                               "elapsed_s": round(elapsed, 6)}
+        if total_bytes:
+            rec["total_bytes"] = int(total_bytes)
+            rec["frac"] = round(min(1.0, int(streamed_bytes)
+                                    / int(total_bytes)), 6)
+        if elapsed > 0 and streamed_bytes:
+            rate = int(streamed_bytes) / elapsed
+            rec["bytes_per_s"] = round(rate, 1)
+            rec["gb_per_s"] = round(rate / 1e9, 6)
+            if total_bytes and int(total_bytes) > int(streamed_bytes):
+                rec["eta_s"] = round(
+                    (int(total_bytes) - int(streamed_bytes)) / rate, 3)
+        if groups_dispatched is not None:
+            rec["groups_dispatched"] = int(groups_dispatched)
+        if groups_retired is not None:
+            rec["groups_retired"] = int(groups_retired)
+        if inflight_depth is not None:
+            rec["inflight_depth"] = int(inflight_depth)
+        self.ledger_write("progress", write=write, **rec)
+        return True
 
     def note_data(self, data: Optional[dict]) -> None:
         """Record the latest data-plane run summary (ISSUE 8) so the

@@ -77,6 +77,18 @@ LANES: Tuple[str, ...] = ("reader", "staging", "h2d", "device", "retire")
 #: collective attribution is ``obs/fleet.py``'s ``fleet_bottleneck``.
 FLEET_LANES: Tuple[str, ...] = LANES + ("collective",)
 
+#: Phase-delta fallback when a run carries no ``group`` records (batch
+#: ledgers, pre-v2 ledgers, a live run before any group retired): which
+#: resource lane each streaming phase blames.  ``dispatch`` maps to
+#: device — a large dispatch share means the enqueue blocked on a full
+#: device queue — and so do ``retire_wait``, ``compute_tail`` and the
+#: legacy ``drain`` they decomposed from.  The ONE copy of this rule
+#: table: ``tuning/engine.py`` and ``tools/obswatch.py`` both read it.
+PHASE_LANE = {"read_wait": "reader", "stage": "staging",
+              "dispatch": "device", "retire_wait": "device",
+              "compute_tail": "device", "drain": "device",
+              "h2d_tail": "h2d"}
+
 _Interval = Tuple[float, float]
 
 

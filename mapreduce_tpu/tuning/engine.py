@@ -82,7 +82,7 @@ from __future__ import annotations
 from typing import Callable, Iterable, List, Optional
 
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG, GEOMETRY_PRESETS
-from mapreduce_tpu.obs import datahealth, timeline
+from mapreduce_tpu.obs import datahealth, history, timeline
 
 #: Bumped when the rule table / proposal schema changes shape.
 TUNER_VERSION = 1
@@ -174,15 +174,10 @@ def _num(v) -> Optional[float]:
 
 #: Phase-delta fallback when a run carries no ``group`` records (batch
 #: ledgers, pre-v2 ledgers, the ledgerless hint path): which resource
-#: each streaming phase blames.  ``dispatch`` maps to device — a large
-#: dispatch share means the enqueue blocked on a full device queue (the
-#: obs_report "dispatch-bound" read) — and so do ``retire_wait``,
-#: ``compute_tail`` (queued device work at stream end) and the legacy
-#: ``drain`` they decomposed from.
-_PHASE_LANE = {"read_wait": "reader", "stage": "staging",
-               "dispatch": "device", "retire_wait": "device",
-               "compute_tail": "device", "drain": "device",
-               "h2d_tail": "h2d"}
+#: each streaming phase blames.  The canonical table lives in
+#: ``obs/timeline.py`` (jax-free) so ``tools/obswatch.py``'s
+#: bound-so-far fallback reads the exact same rule.
+_PHASE_LANE = timeline.PHASE_LANE
 
 
 def _phase_resource(phases: dict) -> Optional[str]:
@@ -205,29 +200,15 @@ def derive_signals(records: Iterable[dict],
     and the data-health classification.  Missing pieces degrade to None —
     absence of a signal is itself information, never an error (the ledger
     forward-compat contract)."""
-    records = [r for r in records if isinstance(r, dict)]
-    chosen = run_id
-    if chosen is None:
-        for r in records:
-            if r.get("run_id") is not None:
-                chosen = r.get("run_id")
-                break
-    recs = [r for r in records if r.get("run_id") == chosen]
-    # Merged fleet ledgers (ISSUE 13): every host's records share one
-    # run_id, and reconstructing a timeline from ALL of them would fuse
-    # the hosts' lanes into a chimera no host actually ran (cross-host
-    # "overlap" destroys exclusivity; data records double-count).  The
-    # synthesized `fleet` record marks a merged stream — anchor every
-    # single-host signal on ONE host's view (the coordinator when
-    # present) and let the fleet verdict carry the cross-host story.
-    fleet = next((r for r in recs if r.get("kind") == "fleet"), None)
-    if fleet is not None:
-        stamped = sorted({r.get("host") for r in recs
-                          if isinstance(r.get("host"), int)
-                          and not isinstance(r.get("host"), bool)})
-        if stamped:
-            anchor = 0 if 0 in stamped else stamped[0]
-            recs = [r for r in recs if r.get("host") in (anchor, None)]
+    # Run selection + merged-fleet host anchoring live in the run-history
+    # warehouse now (ISSUE 14: obs/history.resolve_prior is the one
+    # prior-run read): the chosen run's records — and, on a merged fleet
+    # stream, ONE host's view of them (reconstructing a timeline from
+    # every host's records would fuse the lanes into a chimera no host
+    # ran) — come back as the prior's run view.
+    prior = history.resolve_prior(records=records, run_id=run_id)
+    chosen, recs, fleet = prior["run_id"], prior["run_records"], \
+        prior["fleet"]
     start = next((r for r in recs if r.get("kind") == "run_start"), None)
     end = next((r for r in recs if r.get("kind") == "run_end"), None)
     phases = dict((end or {}).get("phases") or {})

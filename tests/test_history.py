@@ -1,0 +1,462 @@
+"""Run-history warehouse + live run watching (ISSUE 14).
+
+Covers the jax-free warehouse (ingest across ledger versions v2..v8,
+instance-aware dedupe on the crash+relaunch pattern, drift verdicts
+against hand-computed series, resolve_prior parity with the three
+resolvers it replaced, byte-stable re-ingest) and the live half (the
+v8 ``progress`` heartbeat emitted by a real CPU streamed run, its <1 ms
+host-side bound, and ``tools/obswatch.py`` tailing a growing file
+written by that run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tools" / "fixtures"
+
+from mapreduce_tpu.obs import datahealth, history  # noqa: E402
+
+sys.path.insert(0, str(REPO / "tools"))
+try:
+    import obs_report  # noqa: E402
+    import obswatch  # noqa: E402
+finally:
+    sys.path.pop(0)
+
+
+def _read(path) -> list:
+    return history.read_jsonl(str(path))
+
+
+# -- selftest entries (the tier-1/smoke shell gates, importable too) ---------
+
+@pytest.mark.smoke
+def test_history_selftest():
+    assert history.selftest() == 0
+
+
+@pytest.mark.smoke
+def test_obswatch_selftest():
+    assert obswatch.selftest() == 0
+
+
+# -- ingest across ledger versions -------------------------------------------
+
+def test_ingest_across_versions(tmp_path):
+    """One warehouse over the whole fixture zoo: v2-v5 mini runs, the v6
+    geometry run, the v7 fleet shards (fleet verdict attached), the v8
+    in-flight run, and the v99 future ledger — every version ingests,
+    none errors (the forward-compat contract)."""
+    idx = history.ingest([str(FIXTURES / "mini_ledger.jsonl"),
+                          str(FIXTURES / "mini_ledger_b.jsonl"),
+                          str(FIXTURES / "fleet_ledger.jsonl"),
+                          str(FIXTURES / "future_ledger.jsonl")],
+                         str(tmp_path))
+    rows = {r["run_id"]: r for r in idx["runs"].values()}
+    assert len(idx["runs"]) == 12  # 9 mini + 1 b + 1 fleet + 1 future
+    assert rows["fixture01"]["completed"] is True
+    assert rows["fixture05"]["data_verdict"] == "spill-bound"
+    assert rows["fixture06"]["geometry"] == "tall512"
+    assert rows["fleet01"]["fleet_bottleneck"] == "straggler-bound"
+    assert rows["future01"]["completed"] is True
+    # The in-flight v8 run keeps its last heartbeat in the digest.
+    w = rows["fixture10"]
+    assert w["completed"] is False and w["crashed"] is False
+    dig = history.read_digest(str(tmp_path), w["id"])
+    assert dig["progress"]["frac"] == 0.5 and dig["progress"]["eta_s"] == 2.0
+    # Every run landed under a config key and its digest file exists.
+    for r in idx["runs"].values():
+        assert r["key"].count("/") == 5, r
+        assert history.read_digest(str(tmp_path), r["id"]) is not None
+
+
+def test_instance_aware_dedupe(tmp_path):
+    """The crash+relaunch pattern (the documented multi-host contract:
+    one shared run_id, append-mode file): two run_starts under one id
+    ingest as two INSTANCES — crashed attempt and recovery never fuse —
+    and re-ingest never duplicates them."""
+    led = tmp_path / "crash.jsonl"
+    recs = [
+        {"ts": 1.0, "run_id": "shared", "kind": "run_start",
+         "ledger_version": 8, "job": "wordcount", "backend": "xla",
+         "driver": "run_job", "chunk_bytes": 4096},
+        {"ts": 2.0, "run_id": "shared", "kind": "step", "step_first": 0,
+         "step_last": 0, "steps": 1, "group_bytes": 4096,
+         "cursor_bytes": 4096, "phases": {"dispatch": 0.1}},
+        {"ts": 3.0, "run_id": "shared", "kind": "failure", "step": 1,
+         "cursor_bytes": 4096, "error": "boom"},
+        {"ts": 4.0, "run_id": "shared", "kind": "run_start",
+         "ledger_version": 8, "job": "wordcount", "backend": "xla",
+         "driver": "run_job", "chunk_bytes": 4096},
+        {"ts": 5.0, "run_id": "shared", "kind": "step", "step_first": 0,
+         "step_last": 1, "steps": 2, "group_bytes": 8192,
+         "cursor_bytes": 8192, "phases": {"dispatch": 0.2}},
+        {"ts": 6.0, "run_id": "shared", "kind": "run_end", "bytes": 8192,
+         "elapsed_s": 0.5, "phases": {"dispatch": 0.2}},
+    ]
+    led.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    idx = history.ingest([str(led)], str(tmp_path / "h"))
+    rows = sorted(idx["runs"].values(), key=history._row_order)
+    assert len(rows) == 2, rows
+    assert [r["instance"] for r in rows] == [0, 1]
+    assert rows[0]["crashed"] is True and rows[0]["completed"] is False
+    assert rows[1]["crashed"] is False and rows[1]["completed"] is True
+    idx2 = history.ingest([str(led)], str(tmp_path / "h"))
+    assert len(idx2["runs"]) == 2, "re-ingest must not duplicate instances"
+
+
+def test_byte_stable_reingest(tmp_path):
+    """Same ledgers in -> byte-identical index AND digest files out."""
+    srcs = [str(FIXTURES / "history_ledger.jsonl"),
+            str(FIXTURES / "fleet_ledger.jsonl")]
+    d = str(tmp_path / "h")
+    history.ingest(srcs, d)
+
+    def fingerprint():
+        out = {}
+        for root, _, files in os.walk(d):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                out[os.path.relpath(p, d)] = open(p, "rb").read()
+        return out
+
+    first = fingerprint()
+    history.ingest(srcs, d)
+    assert fingerprint() == first, "re-ingest must rewrite identical bytes"
+
+
+# -- drift verdicts -----------------------------------------------------------
+
+def _row(i, gbps, key="wc/x/b20-c4096/default/off/split", **kw):
+    r = {"id": f"r{i}", "ts": float(i), "run_id": f"r{i}", "instance": 0,
+         "key": key, "group": "/".join(key.split("/")[:3]),
+         "geometry": key.split("/")[3], "combiner": key.split("/")[4],
+         "map_impl": key.split("/")[5], "gb_per_s": gbps}
+    r.update(kw)
+    return r
+
+
+def test_drift_hand_series():
+    """The rule table against hand-computed series (the datahealth
+    fixture discipline)."""
+    # regressing: baseline median(0.10, 0.12, 0.11) = 0.11; latest 0.09
+    # is 18.2% below the 10% gate.
+    v = history.classify_drift(
+        [_row(i, g) for i, g in enumerate([0.10, 0.12, 0.11, 0.09])])
+    assert v["verdict"] == "regressing"
+    assert v["signals"]["baseline_gbps"] == 0.11
+    assert v["signals"]["delta_frac"] == round((0.09 - 0.11) / 0.11, 4)
+    # improving: 0.14 vs median(0.10, 0.10) = +40%.
+    v = history.classify_drift(
+        [_row(i, g) for i, g in enumerate([0.10, 0.10, 0.14])])
+    assert v["verdict"] == "improving"
+    # steady: +5% is under the gate.
+    v = history.classify_drift(
+        [_row(i, g) for i, g in enumerate([0.10, 0.10, 0.105])])
+    assert v["verdict"] == "steady"
+    # config-drift outranks the throughput compare: the stamp moved.
+    rows = [_row(0, 0.10), _row(1, 0.05,
+                                key="wc/x/b20-c4096/tall512/off/split")]
+    v = history.classify_drift(rows)
+    assert v["verdict"] == "config-drift"
+    assert "geometry" in v["flags"][0]["detail"]
+    # no-history: one run is not a trend; an empty group even less so.
+    assert history.classify_drift([_row(0, 0.1)])["verdict"] == "no-history"
+    assert history.classify_drift([])["verdict"] == "no-history"
+    # The baseline window slides: only the last DRIFT_WINDOW priors vote
+    # (an ancient fast run must not regress every future forever).
+    old = [_row(i, 9.9) for i in range(2)]
+    recent = [_row(2 + i, 0.10) for i in range(history.DRIFT_WINDOW)]
+    v = history.classify_drift(old + recent + [_row(99, 0.10)])
+    assert v["verdict"] == "steady", v
+
+
+def test_drift_on_fixture_series(tmp_path):
+    """The checked-in 4-run series: median(0.100, 0.098, 0.101) = 0.100
+    baseline, latest 0.085 -> regressing at 15%."""
+    idx = history.ingest([str(FIXTURES / "history_ledger.jsonl")],
+                         str(tmp_path))
+    v = history.classify_drift(
+        history.group_rows(idx, "wordcount/pallas/b28-c4194304"))
+    assert v["verdict"] == "regressing"
+    assert v["signals"]["baseline_gbps"] == 0.1
+    assert v["signals"]["latest_gbps"] == 0.085
+    rep = history.drift_report(idx)
+    assert rep["wordcount/xla/b28-c4194304"]["verdict"] == "config-drift"
+    # Longitudinal queries: the series and the verdict streak.
+    key = "wordcount/pallas/b28-c4194304/default/off/split"
+    assert [v for _, v in history.series(idx, key)] \
+        == [0.1, 0.098, 0.101, 0.085]
+    assert history.verdict_streak(idx, key) \
+        == {"value": "skew-hot", "length": 4, "runs": 4}
+    shares = history.phase_share_series(str(tmp_path), idx, key, "dispatch")
+    assert len(shares) == 4 and all(0.7 < s < 0.9 for _, s in shares)
+
+
+# -- resolve_prior parity -----------------------------------------------------
+
+def test_resolve_prior_combiner_parity():
+    """resolve_prior(records=...) reproduces datahealth.resolve_combiner
+    bit-for-bit — including the append-mode latest-record semantics."""
+    skew = {"kind": "data", "run_id": "a", "tokens": 1000,
+            "top_count": 200, "chunks": 1}
+    clean = {"kind": "data", "run_id": "b", "tokens": 1000,
+             "top_count": 10, "chunks": 1}
+    cases = [[skew], [clean], [], [clean, skew], [skew, clean],
+             _read(FIXTURES / "mini_ledger.jsonl"),
+             _read(FIXTURES / "mini_ledger_b.jsonl"),
+             _read(FIXTURES / "future_ledger.jsonl")]
+    for recs in cases:
+        assert history.resolve_prior(records=recs)["combiner"] \
+            == datahealth.resolve_combiner(recs)
+
+
+def test_resolve_prior_geometry_parity(tmp_path):
+    """resolve_prior(profile_path=...) reproduces the resolve_auto
+    semantics — and resolve_auto itself now routes through it."""
+    from mapreduce_tpu.analysis.geometry import resolve_auto
+    from mapreduce_tpu.config import GEOMETRY_PRESETS
+
+    spec = GEOMETRY_PRESETS["tall512"].as_dict()
+    prof = tmp_path / "tuned.json"
+    prof.write_text(json.dumps({"profiles": {
+        "wordcount-geometry/a": {"recorded_at": "2026-01-01",
+                                 "config": {"geometry": "tall512"}},
+        "wordcount-geometry/b": {"recorded_at": "2026-02-01",
+                                 "config": {"geometry": spec}},
+        "wordcount-geometry/c": {"recorded_at": "2026-03-01",
+                                 "config": {"geometry": "default"}},
+    }}))
+    # Freshest non-default entry wins: the spec dict (c is default).
+    assert resolve_auto(str(prof)) == spec
+    # A future-shaped spec dict is skipped, falling back to the preset.
+    prof.write_text(json.dumps({"profiles": {
+        "wordcount-geometry/a": {"recorded_at": "2026-01-01",
+                                 "config": {"geometry": "tall512"}},
+        "wordcount-geometry/b": {"recorded_at": "2026-02-01",
+                                 "config": {"geometry": {"warp": 9}}},
+    }}))
+    assert resolve_auto(str(prof)) == "tall512"
+    # Missing file / no usable entry degrade to 'default'.
+    assert resolve_auto(str(tmp_path / "missing.json")) == "default"
+
+
+def test_resolve_prior_run_view_parity():
+    """derive_signals' run selection is resolve_prior's run view now:
+    same chosen run, and the merged-fleet host anchoring holds (the
+    chimera regression of PR 13)."""
+    from mapreduce_tpu import tuning
+
+    for fx in ("tuner_reader_bound", "tuner_device_bound",
+               "tuner_skewhot", "tuner_geometry"):
+        recs = _read(FIXTURES / f"{fx}.jsonl")
+        sig = tuning.derive_signals(recs)
+        prior = history.resolve_prior(records=recs)
+        assert sig["run_id"] == prior["run_id"], fx
+    # The merged-fleet anchor: host-1 records drop out of the run view.
+    merged = [
+        {"run_id": "m", "kind": "run_start", "host": 0, "backend": "xla"},
+        {"run_id": "m", "kind": "run_start", "host": 1, "backend": "xla"},
+        {"run_id": "m", "kind": "group", "host": 1, "step_first": 0,
+         "staged_at": 1.0, "dispatched_at": 1.1, "token_ready_at": 2.0,
+         "retired_at": 2.1},
+        {"run_id": "m", "kind": "fleet",
+         "fleet_bottleneck": {"verdict": "straggler-bound"}},
+    ]
+    prior = history.resolve_prior(records=merged)
+    assert prior["fleet"] is not None
+    assert all(r.get("host") in (0, None) for r in prior["run_records"])
+    sig = tuning.derive_signals(merged)
+    assert sig["fleet_bottleneck"] == "straggler-bound"
+    assert sig["bottleneck"] is None  # host 1's group never reconstructs
+
+
+def test_resolve_prior_warehouse_read(tmp_path):
+    """The index-backed prior: latest row + group drift for a key — the
+    warm-start read ROADMAP item 2's service bills from."""
+    idx = history.ingest([str(FIXTURES / "history_ledger.jsonl")],
+                         str(tmp_path))
+    assert len(idx["runs"]) == 6
+    key = "wordcount/pallas/b28-c4194304/default/off/split"
+    p = history.resolve_prior(index_dir=str(tmp_path), config_key=key)
+    assert p["history"]["rows"] == 4
+    assert p["history"]["latest"]["run_id"] == "h4"
+    assert p["history"]["drift"]["verdict"] == "regressing"
+    # An unknown key is an honest empty prior, not an error.
+    p = history.resolve_prior(index_dir=str(tmp_path), config_key="no/such"
+                              "/key/default/off/split")
+    assert p["history"]["rows"] == 0 and p["history"]["latest"] is None
+
+
+# -- the v8 progress heartbeat on a real CPU streamed run ---------------------
+
+@pytest.fixture(scope="module")
+def streamed_ledger(tmp_path_factory):
+    """One real telemetered CPU streamed run with the heartbeat cadence
+    at 0 (every opportunity), plus a SECOND run appended to the same
+    ledger file — the append-mode shape bench.py's BENCH_LEDGER
+    produces.  Shared by the heartbeat/obswatch/warehouse tests below
+    (one compile, many asserts)."""
+    from mapreduce_tpu import obs
+    from mapreduce_tpu.config import Config
+    from mapreduce_tpu.models.wordcount import WordCountJob
+    from mapreduce_tpu.runtime import executor
+
+    d = tmp_path_factory.mktemp("heartbeat")
+    path = d / "in.txt"
+    path.write_text("the quick brown fox jumps over the lazy dog " * 1800)
+    led = str(d / "run.jsonl")
+    cfg = Config(chunk_bytes=8192, backend="xla", superstep=2)
+    run_ids = []
+    for _ in range(2):
+        tel = obs.Telemetry.create(ledger_path=led, progress_every_s=0.0)
+        try:
+            executor.run_job(WordCountJob(cfg), str(path), config=cfg,
+                             telemetry=tel)
+        finally:
+            tel.close()
+        run_ids.append(tel.run_id)
+    return {"ledger": led, "run_ids": run_ids,
+            "corpus_bytes": os.path.getsize(path)}
+
+
+def test_progress_records_on_real_run(streamed_ledger):
+    """The ledger-v8 contract: flushed `progress` records with cursor/
+    total/fraction/rate, monotone within a run, total == the corpus
+    size, and the run accounted to 100%."""
+    from mapreduce_tpu import obs
+
+    recs = list(obs.read_ledger(streamed_ledger["ledger"]))
+    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 8
+    rid = streamed_ledger["run_ids"][0]
+    prog = [r for r in recs
+            if r["kind"] == "progress" and r["run_id"] == rid]
+    assert prog, "heartbeats must land at cadence 0"
+    cursors = [p["cursor_bytes"] for p in prog]
+    assert cursors == sorted(cursors)
+    assert all(p["total_bytes"] == streamed_ledger["corpus_bytes"]
+               for p in prog)
+    assert prog[-1]["frac"] == 1.0
+    assert prog[-1]["groups_retired"] >= 1
+    assert {"step", "streamed_bytes", "elapsed_s",
+            "inflight_depth"} <= set(prog[-1])
+    # The heartbeat never displaced the per-step/group records.
+    steps = [r for r in recs
+             if r["kind"] == "step" and r["run_id"] == rid]
+    assert steps and steps[-1]["cursor_bytes"] == cursors[-1]
+
+
+def test_progress_cadence_and_overhead(tmp_path):
+    """The wall-clock gate holds (a large cadence emits exactly the
+    first record) and one due emission stays under the 1 ms host bound —
+    the PR-7/8 overhead-bound extension the acceptance criteria name."""
+    from mapreduce_tpu import obs
+
+    led = str(tmp_path / "hb.jsonl")
+    tel = obs.Telemetry.create(ledger_path=led, progress_every_s=3600.0)
+    try:
+        wrote = [tel.progress(step=i, cursor_bytes=i * 10,
+                              streamed_bytes=i * 10, total_bytes=1000)
+                 for i in range(100)]
+        assert wrote[0] is True and not any(wrote[1:]), \
+            "only the first call inside the cadence window may write"
+        # The not-due path: one monotonic read + compare.
+        t0 = time.perf_counter()
+        for i in range(1000):
+            tel.progress(step=i, cursor_bytes=i, streamed_bytes=i)
+        not_due = (time.perf_counter() - t0) / 1000
+        assert not_due < 1e-3, f"not-due heartbeat cost {not_due:.6f}s"
+        # The due path (force): a full record build + flushed append.
+        t0 = time.perf_counter()
+        n = 50
+        for i in range(n):
+            assert tel.progress(step=i, cursor_bytes=i * 100,
+                                streamed_bytes=i * 100, total_bytes=10000,
+                                groups_dispatched=i, groups_retired=i,
+                                inflight_depth=2, force=True)
+        per = (time.perf_counter() - t0) / n
+        assert per < 1e-3, f"due heartbeat emission cost {per:.6f}s"
+    finally:
+        tel.close()
+    # A ledgerless handle has nothing to tail: no write, no error.
+    bare = obs.Telemetry(enabled=True, progress_every_s=0.0)
+    assert bare.progress(step=0, cursor_bytes=0, streamed_bytes=0) is False
+    assert obs.Telemetry.disabled().progress(
+        step=0, cursor_bytes=0, streamed_bytes=0) is False
+
+
+def test_obswatch_tails_growing_real_ledger(streamed_ledger, tmp_path):
+    """The acceptance walk: obswatch renders a live IN-FLIGHT run AND
+    the finished ledger.  A writer thread replays the real run's records
+    into a growing file at the executor's flush granularity while the
+    main thread tails it — every snapshot must parse, the cursor must be
+    monotone, in-flight states must be observed mid-stream, and the
+    final snapshot must read completed with the run's own facts."""
+    rid = streamed_ledger["run_ids"][0]
+    lines = [ln for ln in open(streamed_ledger["ledger"], encoding="utf-8")
+             if json.loads(ln).get("run_id") == rid]
+    live = str(tmp_path / "live.jsonl")
+    stop_at = len(lines)
+    written = threading.Event()
+    done = threading.Event()
+
+    def writer():
+        with open(live, "w", encoding="utf-8") as f:
+            for i, ln in enumerate(lines):
+                f.write(ln)
+                f.flush()
+                written.set()
+                time.sleep(0.003)
+        done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    written.wait(5.0)
+    statuses, cursors = [], []
+    while not done.is_set() or len(statuses) < 1:
+        s = obswatch.snapshot(live)
+        if s is not None:
+            statuses.append(s["status"])
+            if s.get("cursor_bytes") is not None:
+                cursors.append(s["cursor_bytes"])
+        time.sleep(0.002)
+    t.join(10.0)
+    assert stop_at == len(lines) and cursors, cursors
+    assert cursors == sorted(cursors), "tailer cursor must be monotone"
+    assert "in-flight" in statuses, statuses
+    final = obswatch.snapshot(live)
+    assert final["status"] == "completed" and final["frac"] == 1.0
+    assert final["run_id"] == rid
+    assert final["bound"] is not None
+    # The finished REAL ledger renders through the same path (both runs
+    # enumerable via obs_report --list-runs, the satellite surface).
+    full = obswatch.snapshot(streamed_ledger["ledger"])
+    assert full["status"] == "completed"
+    rows = obs_report.list_runs(streamed_ledger["ledger"])
+    assert [r["run_id"] for r in rows] == streamed_ledger["run_ids"]
+    assert all(r["status"] == "completed" for r in rows)
+
+
+def test_warehouse_ingests_append_mode_bench_ledger(streamed_ledger,
+                                                    tmp_path):
+    """The bench BUGFIX shape: one append-mode file, many timed passes —
+    ingest registers EVERY run under one shared config key (same family/
+    backend/corpus/config), which is exactly what the drift series needs."""
+    idx = history.ingest([streamed_ledger["ledger"]], str(tmp_path / "h"))
+    rows = sorted(idx["runs"].values(), key=history._row_order)
+    assert [r["run_id"] for r in rows] == streamed_ledger["run_ids"]
+    keys = {r["key"] for r in rows}
+    assert len(keys) == 1, f"same-config passes must share a key: {keys}"
+    assert all(r["completed"] for r in rows)
+    v = history.classify_drift(rows)
+    assert v["verdict"] in ("steady", "regressing", "improving"), v
+    assert v["signals"]["runs"] == 2

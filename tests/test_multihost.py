@@ -282,7 +282,7 @@ def test_run_job_global_multiprocess_writes_host_shards(tmp_path):
         recs = list(obs.read_ledger(sp))
         assert all(r.get("host") == h for r in recs)
         start = next(r for r in recs if r["kind"] == "run_start")
-        assert start["ledger_version"] == obs.LEDGER_VERSION == 7
+        assert start["ledger_version"] == obs.LEDGER_VERSION == 8
         assert start["processes"] == 2 and start["local_devices"] == 2
         assert set(start["clock"]) == {"wall", "mono"}
         groups = [r for r in recs if r["kind"] == "group"]

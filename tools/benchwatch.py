@@ -56,8 +56,13 @@ def run_step(out_path: str, name: str, cmd: list[str], env: dict,
     # a wedged window leaves per-step forensics (ledger + .flight.json +
     # the .trace.json Perfetto export bench derives from the ledger's
     # group records) the next session can obs_report / trace_export
-    # instead of a bare timeout line.
-    env = {**env, "BENCH_LEDGER": out_path + f".{name}.ledger.jsonl"}
+    # instead of a bare timeout line.  A live step can be WATCHED from
+    # another shell while it runs: python tools/obswatch.py <ledger>.
+    # All steps share ONE run-history warehouse (ISSUE 14): every timed
+    # pass registers into <out>.history, so the window's final
+    # history-report row lands with longitudinal drift verdicts.
+    env = {**env, "BENCH_LEDGER": out_path + f".{name}.ledger.jsonl",
+           "BENCH_HISTORY": out_path + ".history"}
     with open(out_path + f".{name}.out", "w") as stdout_f:
         proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=stdout_f,
                                 stderr=subprocess.STDOUT, text=True)
@@ -322,6 +327,17 @@ def main() -> int:
                 # must carry verify_ok=true (zero mismatches, rc 0).
                 ("family-verify", [sys.executable, "tools/familybench.py",
                                    "verify"], env),
+                # ISSUE 14 run-history report, LAST on purpose: every
+                # streamed row above registered its timed pass into the
+                # shared <out>.history warehouse, so this row renders
+                # the window's per-key series + drift verdicts
+                # (regressing / improving / steady / config-drift) —
+                # the chip window lands with a longitudinal verdict
+                # attached, not just point measurements.  Jax-free and
+                # read-only; rc 1 just means no streamed row landed.
+                ("history-report",
+                 [sys.executable, "mapreduce_tpu/obs/history.py",
+                  "--index", args.out + ".history", "--drift"], env),
             ]
             results = {}
             for name, cmd, e in steps:

@@ -362,7 +362,18 @@ def analyze_run(records: list) -> dict:
     if tune is not None:
         tune = {k: v for k, v in tune.items()
                 if k not in ("ts", "run_id", "kind")}
+    # Live-run heartbeat (ISSUE 14, ledger v8): the LAST `progress`
+    # record — an in-flight/crashed run's cursor, completion fraction
+    # and ETA; tools/obswatch.py renders the same records live.
+    progress = next((r for r in reversed(records)
+                     if r.get("kind") == "progress"), None)
+    if progress is not None:
+        progress = {k: v for k, v in progress.items()
+                    if k not in ("ts", "run_id", "kind")}
     return {
+        "started_ts": start.get("ts") if start else None,
+        "progress": progress,
+        "failure_count": len(failures),
         "timeline": timeline,
         "data": data,
         "data_health": data_health,
@@ -397,10 +408,18 @@ def analyze(path: str) -> list:
     Instances, not just ids (ISSUE 13): the multi-host contract passes
     one shared run_id to every process, and a crash+relaunch recovery
     appends a second run under that id — every run_start opens a new
-    instance (the ``obs/fleet.py`` selection rule), so a crashed attempt
-    and its recovery analyze separately instead of fusing into a chimera
-    (first header + last run_end + combined steps)."""
+    instance (``obs/fleet.py``'s canonical ``split_instances`` rule), so
+    a crashed attempt and its recovery analyze separately instead of
+    fusing into a chimera (first header + last run_end + combined
+    steps)."""
     records = read_ledger(path)
+    fl = _fleet_mod()
+    if fl is not None:
+        return [analyze_run(recs)
+                for _, _, recs in fl.split_instances(records)]
+    # Standalone-copy fallback (this file shipped without the obs
+    # modules): the same rule, inlined — fleet.split_instances is the
+    # canonical implementation.
     by_run: list = []   # (run_id, records) per instance
     current: dict = {}  # run_id -> index into by_run
     for r in records:
@@ -429,6 +448,22 @@ def render_run(a: dict, out) -> None:
     if a["gb_per_s"] is not None:
         out.write(f", {a['gb_per_s']:.4f} GB/s")
     out.write("\n")
+    # Live-run heartbeat (ISSUE 14, ledger v8): an incomplete run's last
+    # `progress` record says where the stream cursor got to — the
+    # difference between "crashed at 10%" and "crashed at 99%", and what
+    # tools/obswatch.py tails while the run is still going.
+    p = a.get("progress")
+    if p and not a["completed"]:
+        out.write(f"  in flight: {p.get('cursor_bytes', '?')} bytes")
+        if p.get("frac") is not None:
+            out.write(f" ({100 * p['frac']:.1f}%)")
+        if p.get("gb_per_s") is not None:
+            out.write(f", {p['gb_per_s']:.4f} GB/s")
+        if p.get("eta_s") is not None:
+            out.write(f", ETA {p['eta_s']:.1f}s")
+        if p.get("inflight_depth") is not None:
+            out.write(f", inflight {p['inflight_depth']}")
+        out.write("\n")
     if a["phases"]:
         streaming = ("read_wait", "stage", "dispatch", "retire_wait")
         total = sum(v for k, v in a["phases"].items()
@@ -564,6 +599,67 @@ def render_run(a: dict, out) -> None:
         out.write(f"  FAILURE at step {f['step']}: {f['error']}\n")
         if f.get("flight_dump"):
             out.write(f"    flight dump: {f['flight_dump']}\n")
+
+
+# -- run enumeration (ISSUE 14 satellite) ------------------------------------
+
+def run_status(a: dict) -> str:
+    """completed / crashed / in-flight of one analyzed run — the one
+    rule lives in ``obs/fleet.py`` (``run_status``), shared with
+    ``obswatch`` and the ``history`` digests; the inline expression is
+    the standalone-copy fallback."""
+    fl = _fleet_mod()
+    if fl is not None:
+        return fl.run_status(bool(a.get("completed")),
+                             int(a.get("failure_count") or 0))
+    if a.get("completed"):
+        return "completed"
+    return "crashed" if a.get("failure_count") else "in-flight"
+
+
+def list_runs(path: str) -> list:
+    """Enumerate the run INSTANCES of an append-mode ledger (ISSUE 14
+    satellite): ``--run-id`` requires already knowing the id — this is
+    where the ids come from.  One row per instance, in file order, with
+    the start wall time, family/backend and the geometry/combiner/
+    map-impl stamps the A/B selectors key on."""
+    rows = []
+    for a in analyze(path):
+        h = a.get("header") or {}
+        rows.append({
+            "run_id": a.get("run_id"),
+            "started_ts": a.get("started_ts"),
+            "status": run_status(a),
+            "driver": h.get("driver"),
+            "family": h.get("job"),
+            "backend": h.get("backend"),
+            "geometry": h.get("geometry") or "default",
+            "combiner": h.get("combiner") or "off",
+            "map_impl": h.get("map_impl") or "split",
+            "steps": a.get("steps"),
+            "bytes": a.get("bytes"),
+            "gb_per_s": a.get("gb_per_s"),
+            "cursor_frac": (a.get("progress") or {}).get("frac"),
+        })
+    return rows
+
+
+def render_list(rows: list, out) -> None:
+    import datetime
+
+    for r in rows:
+        ts = r.get("started_ts")
+        when = datetime.datetime.fromtimestamp(ts).strftime(
+            "%Y-%m-%d %H:%M:%S") if isinstance(ts, (int, float)) else "?"
+        geom = "" if r["geometry"] == "default" else f" geom={r['geometry']}"
+        comb = "" if r["combiner"] == "off" else f" combiner={r['combiner']}"
+        tail = f"  {r['gb_per_s']:.4f} GB/s" if r.get("gb_per_s") else ""
+        frac = f" @{100 * r['cursor_frac']:.0f}%" \
+            if r["status"] != "completed" and r.get("cursor_frac") else ""
+        out.write(f"{r['run_id']}  {when}  {r['status']}{frac}  "
+                  f"[{r.get('family', '?')}/{r.get('backend', '?')}"
+                  f"/{r['map_impl']}{geom}{comb}]  "
+                  f"{r.get('steps', '?')} steps{tail}\n")
 
 
 # -- A/B ledger diffing (ISSUE 8 satellite) ----------------------------------
@@ -746,7 +842,7 @@ def selftest() -> int:
     ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 8, f"fixture holds eight runs, got {len(runs)}"
+    assert len(runs) == 9, f"fixture holds nine runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -879,8 +975,37 @@ def selftest() -> int:
     egroups = [r for r in read_ledger(ledger)
                if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
     assert all("data" in g for g in egroups), egroups
-    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6, 7)), \
+    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6, 7, 8)), \
         "runs without a tune record must carry None"
+    # Run 9 in file order (ISSUE 14): a ledger-v8 run still IN FLIGHT —
+    # no run_end, but two `progress` heartbeat records.  Hand arithmetic:
+    # 16 MiB of the 32 MiB corpus at 8 MiB/s -> 50.0%, ETA 2.0 s.  The
+    # report must surface the last heartbeat instead of a bare DID NOT
+    # COMPLETE, and the status classifier must read in-flight (no
+    # failure record), not crashed.
+    w = runs[8]
+    assert w["header"]["ledger_version"] == 8, w["header"]
+    assert not w["completed"] and w["failure_count"] == 0
+    assert w["progress"]["frac"] == 0.5, w["progress"]
+    assert w["progress"]["eta_s"] == 2.0, w["progress"]
+    assert run_status(w) == "in-flight"
+    # --list-runs (ISSUE 14 satellite): one row per instance with the
+    # stamps and status — where --run-id ids come from.
+    lrows = list_runs(ledger)
+    assert len(lrows) == 9, lrows
+    byid = {r["run_id"]: r for r in lrows}
+    assert byid["fixture10"]["status"] == "in-flight"
+    assert byid["fixture10"]["cursor_frac"] == 0.5
+    assert byid["fixture01"]["status"] == "completed"
+    assert byid["fixture08"]["combiner"] == "hot-cache", byid["fixture08"]
+    assert byid["fixture03"]["map_impl"] == "fused", byid["fixture03"]
+    import io
+
+    lbuf = io.StringIO()
+    render_list(lrows, lbuf)
+    ltext = lbuf.getvalue()
+    assert "fixture10" in ltext and "in-flight @50%" in ltext, ltext
+    assert ltext.count("\n") == 9, ltext
     # --run-id (ISSUE 13 satellite): an append-mode ledger's compare pick
     # honors an explicit selector instead of always the last completed
     # run, and an absent id is an honest miss, not a silent fallback.
@@ -917,8 +1042,11 @@ def selftest() -> int:
     render_run(h8, buf)
     render_run(f6, buf)
     render_run(p9, buf)
+    render_run(w, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
+    assert "in flight: 16777216 bytes (50.0%)" in body, body
+    assert "ETA 2.0s" in body, body
     assert "fleet: host 0 of 2 processes" in body, body
     assert ("combiner: hot-cache — 42000 hits (70.00% of tokens), "
             "40000 sort rows deleted, 2000 flushes (150 cold)") in body, body
@@ -1029,6 +1157,11 @@ def main(argv=None) -> int:
                     help="select one run from an append-mode ledger "
                          "(default: render every run; --compare defaults "
                          "to each side's last completed run)")
+    ap.add_argument("--list-runs", action="store_true",
+                    help="enumerate the ledger's run instances (run_id, "
+                         "start time, family/backend/stamps, completed/"
+                         "crashed/in-flight) — where --run-id ids come "
+                         "from")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"), default=None,
                     help="diff two ledgers' phase shares, bound/bottleneck "
                          "verdicts and data-health dicts in one table "
@@ -1039,6 +1172,18 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.list_runs:
+        if not args.ledger:
+            ap.error("--list-runs requires a ledger path")
+        rows = list_runs(args.ledger)
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            render_list(rows, sys.stdout)
+        if not rows:
+            print("no runs found", file=sys.stderr)
+            return 1
+        return 0
     if args.compare:
         return compare(args.compare[0], args.compare[1], sys.stdout,
                        as_json=args.json, run_id=args.run_id)

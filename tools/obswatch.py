@@ -1,0 +1,557 @@
+#!/usr/bin/env python3
+"""Watch a run that has not ended: tail a live (possibly sharded) run
+ledger and render progress, the bound-so-far, data-health-so-far, and
+fleet straggler skew (ISSUE 14 tentpole, half 2).
+
+Every prior obs surface required a FINISHED ledger; this one reads a
+ledger while the executor is still appending to it.  The executor's v8
+``progress`` heartbeat (wall-clock cadence, flushed per record) carries
+the stream cursor, completion fraction, throughput-so-far and the ETA
+derived from the byte cursor; around it this tool reconstructs what the
+partial record stream already proves:
+
+* **progress** — the last heartbeat: cursor / total bytes, %, GB/s,
+  ETA, in-flight depth, groups dispatched vs retired.  A ledger with NO
+  progress records (pre-v8, or a heartbeat-less writer) degrades to the
+  last step record's cursor — graceful, never an error;
+* **bound so far** — the critical-path ``bottleneck`` verdict over the
+  ``group`` lifecycle records retired SO FAR (``obs/timeline.py``),
+  falling back to the summed step phase deltas when no groups have
+  retired yet;
+* **data health so far** — the per-group ``data`` counter dicts summed
+  into one partial summary and classified by ``obs/datahealth.py``
+  (the final per-run ``data`` record wins once it lands);
+* **fleet skew so far** — when ``<ledger>.h<p>.jsonl`` shards sit next
+  to the file, the per-superstep straggler skew and slowest host from
+  ``obs/fleet.py`` over the groups every host has retired so far.
+
+Follow mode polls the file on ``--interval`` until the run completes,
+crashes, or ``--max-seconds`` elapses, printing one status line per
+change; ``--once`` renders the current state and exits.  Works on a
+finished ledger too — the same render, with the run_end facts.
+
+Deliberately jax-free and stdlib-only (the ``obs_report`` contract):
+the obs modules load by file path, so a laptop can watch a ledger
+rsynced (or NFS-mounted) from the TPU box.  ``--selftest`` runs the
+checked-in fixtures against hand arithmetic; wired into
+``tools/tier1.sh`` and ``tools/smoke.sh``.
+
+Usage::
+
+    python tools/obswatch.py /path/run.jsonl            # follow
+    python tools/obswatch.py /path/run.jsonl --once     # one snapshot
+    python tools/obswatch.py /path/run.jsonl --json
+    python tools/obswatch.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_OBS_MODS: dict = {}
+
+
+def _obs_mod(name: str):
+    """A jax-free obs module loaded by file path (the obs_report
+    pattern); None when unavailable — the watcher drops that section."""
+    if name not in _OBS_MODS:
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           os.pardir, "mapreduce_tpu", "obs", name + ".py")
+        try:
+            if os.path.exists(src):
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location(
+                    f"_mapreduce_tpu_watch_{name}", src)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _OBS_MODS[name] = mod
+            else:
+                import importlib
+
+                _OBS_MODS[name] = importlib.import_module(
+                    f"mapreduce_tpu.obs.{name}")
+        except Exception:
+            _OBS_MODS[name] = False
+    return _OBS_MODS[name] or None
+
+
+def read_ledger(path: str) -> list:
+    """Tolerant JSONL read through the ONE canonical reader
+    (``obs/ledger.read_ledger``: unparseable lines skip — on a live file
+    a half-written last line is EXPECTED; it parses on the next poll).
+    A not-yet-created file reads as empty (the watcher keeps polling)."""
+    led = _obs_mod("ledger")
+    if led is None:
+        return []
+    try:
+        return list(led.read_ledger(path))
+    except OSError:
+        return []
+
+
+class _Tail:
+    """Incremental main-file reader for follow mode: each poll parses
+    only the bytes appended since the last one (complete lines only — a
+    torn tail stays unconsumed until its newline lands), so a
+    multi-hour tail costs O(new records) per poll instead of re-parsing
+    the whole ledger every ``--interval``.  Applies the canonical
+    reader's skip rule (unparseable lines are forensics, not errors); a
+    truncated/rotated file restarts from byte 0.  Shard files (fleet
+    runs) are still re-read per snapshot — they only matter on
+    multi-host watches and stay small per host."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.records: list = []
+
+    def poll(self) -> list:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < self.offset:  # truncation/rotation: restart
+                    self.offset, self.records = 0, []
+                if size == self.offset:
+                    return self.records
+                f.seek(self.offset)
+                chunk = f.read(size - self.offset)
+        except OSError:
+            return self.records
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return self.records
+        self.offset += end + 1
+        for line in chunk[:end].split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(rec, dict):
+                self.records.append(rec)
+        return self.records
+
+
+#: Per-group `data` dict fields that SUM across retired groups (the
+#: counters); `occupancy`/`top_mass` are running gauges — last wins.
+_SUM_FIELDS = ("chunks", "overlong", "rescued", "dropped_tokens",
+               "dropped_uniques", "rescue_invocations",
+               "rescue_escalations", "fallback_chunks", "spill_rows",
+               "combiner_hits", "combiner_flushes", "combiner_evicted")
+
+
+def data_so_far(groups: list) -> dict | None:
+    """The partial data summary: per-group counter dicts summed, running
+    gauges taken from the last retired group.  None with no data dicts
+    (plain-mode runs, pre-v3 ledgers)."""
+    dicts = [g.get("data") for g in groups if isinstance(g.get("data"), dict)]
+    if not dicts:
+        return None
+    out: dict = {"groups": len(dicts)}
+    for f in _SUM_FIELDS:
+        vals = [d.get(f) for d in dicts
+                if isinstance(d.get(f), (int, float))]
+        if vals:
+            out[f] = sum(vals)
+    for f in ("occupancy", "top_mass"):
+        last = next((d.get(f) for d in reversed(dicts)
+                     if d.get(f) is not None), None)
+        if last is not None:
+            out["table_occupancy" if f == "occupancy" else f] = last
+    return out
+
+
+def _num(v):
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def snapshot(ledger_path: str, run_id: str | None = None,
+             records: list | None = None) -> dict | None:
+    """The current state of (by default) the LAST run instance in the
+    ledger — on a live file, the run being written right now.  None when
+    the file holds no records yet (the watcher keeps polling).
+    ``records`` lets follow mode pass the incrementally tailed stream
+    (:class:`_Tail`) instead of re-reading the file."""
+    hist = _obs_mod("history")
+    tl = _obs_mod("timeline")
+    dh = _obs_mod("datahealth")
+    fl = _obs_mod("fleet")
+    if records is None:
+        records = read_ledger(ledger_path)
+    if hist is None:
+        return None
+    runs = hist.split_instances(records)
+    if run_id is not None:
+        runs = [r for r in runs if r[0] == run_id]
+    if not runs:
+        return None
+    rid, instance, recs = runs[-1]
+    start = next((r for r in recs if r.get("kind") == "run_start"), None)
+    end = next((r for r in recs if r.get("kind") == "run_end"), None)
+    failures = [r for r in recs if r.get("kind") == "failure"]
+    # The one completed/crashed/in-flight rule (fleet.run_status).
+    status = fl.run_status(end is not None, len(failures)) \
+        if fl is not None else "completed" if end is not None \
+        else ("crashed" if failures else "in-flight")
+    steps = [r for r in recs if r.get("kind") == "step"]
+    groups = [r for r in recs if r.get("kind") == "group"]
+    progress = next((r for r in reversed(recs)
+                     if r.get("kind") == "progress"), None)
+
+    # Progress: the heartbeat when one landed; else degrade to the last
+    # step record's cursor (pre-v8 ledgers still watchable).
+    cursor = total = frac = gbps = eta = depth = None
+    if progress is not None:
+        cursor = _num(progress.get("cursor_bytes"))
+        total = _num(progress.get("total_bytes"))
+        frac = _num(progress.get("frac"))
+        gbps = _num(progress.get("gb_per_s"))
+        eta = _num(progress.get("eta_s"))
+        depth = _num(progress.get("inflight_depth"))
+    elif steps:
+        cursor = _num(steps[-1].get("cursor_bytes"))
+    if end is not None:
+        gbps = _num(end.get("gb_per_s")) or gbps
+        frac, eta = 1.0, 0.0
+        cursor = _num(end.get("bytes")) or cursor
+
+    # Bound so far: the measured timeline over retired groups; phase
+    # deltas as the fallback, through the ONE phase->lane rule table
+    # (timeline.PHASE_LANE — the tuner reads the same one).
+    art = tl.reconstruct(recs, run_id=rid) if tl is not None else None
+    bound = source = None
+    if art is not None:
+        bound, source = art["bottleneck"]["resource"], "timeline"
+    elif tl is not None:
+        phases: dict = {}
+        src = (end or {}).get("phases") if end else None
+        for r in ([{"phases": src}] if src else steps):
+            for k, v in (r.get("phases") or {}).items():
+                if _num(v) is not None:
+                    phases[k] = phases.get(k, 0.0) + float(v)
+        shares: dict = {}
+        for ph, lane in tl.PHASE_LANE.items():
+            if phases.get(ph):
+                shares[lane] = shares.get(lane, 0.0) + phases[ph]
+        if shares:
+            bound = max(shares, key=lambda ln: shares[ln])
+            source = "phases"
+
+    # Data health so far: the run's own `data` record once it lands,
+    # else the per-group counters summed.
+    data = next((r for r in recs if r.get("kind") == "data"), None)
+    partial = data is None
+    if data is None:
+        data = data_so_far(groups)
+    health = None
+    if data is not None and dh is not None:
+        health = dh.classify({k: v for k, v in data.items()
+                              if k not in ("ts", "run_id", "kind")})
+
+    # Fleet skew so far: shards next to the file, merged over whatever
+    # every host has retired up to now.
+    fleet = None
+    if fl is not None:
+        try:
+            paths = fl.shard_paths(ledger_path)
+            if paths:
+                by_host = {h: fl.read_jsonl(p) for h, p in paths.items()}
+                view = fl.fleet_view(by_host, rid)
+                if view is not None:
+                    fleet = {
+                        "hosts": view["hosts"],
+                        "total_skew_s":
+                            view["straggler"]["total_skew_s"],
+                        "supersteps": view["straggler"]["supersteps"],
+                        "slowest_host":
+                            view["straggler"]["slowest_host"],
+                        "verdict":
+                            view["fleet_bottleneck"]["verdict"],
+                    }
+        except Exception:
+            fleet = None  # a torn shard mid-write: next poll
+    return {
+        "run_id": rid,
+        "instance": instance,
+        "status": status,
+        "header": {k: (start or {}).get(k) for k in
+                   ("job", "driver", "backend", "devices", "map_impl",
+                    "combiner", "geometry", "ledger_version")},
+        "steps": sum(int(_num(r.get("steps")) or 1) for r in steps),
+        "groups_retired": len(groups),
+        "cursor_bytes": int(cursor) if cursor is not None else None,
+        "total_bytes": int(total) if total is not None else None,
+        "frac": frac,
+        "gb_per_s": gbps,
+        "eta_s": eta,
+        "inflight_depth": int(depth) if depth is not None else None,
+        "heartbeat": progress is not None,
+        "bound": bound,
+        "bound_source": source,
+        "bottleneck": (art or {}).get("bottleneck"),
+        "data_so_far": data,
+        "data_partial": partial,
+        "data_health": health,
+        "fleet": fleet,
+    }
+
+
+def _mib(n) -> str:
+    return f"{n / (1 << 20):.1f} MiB" if isinstance(n, (int, float)) else "?"
+
+
+def status_line(s: dict) -> str:
+    """The one-line follow-mode form."""
+    parts = [s["status"]]
+    if s.get("frac") is not None:
+        parts.append(f"{100 * s['frac']:.1f}%")
+    elif s.get("cursor_bytes") is not None:
+        parts.append(_mib(s["cursor_bytes"]))
+    if s.get("gb_per_s") is not None:
+        parts.append(f"{s['gb_per_s']:.4f} GB/s")
+    if s.get("eta_s") is not None and s["status"] == "in-flight":
+        parts.append(f"ETA {s['eta_s']:.1f}s")
+    if s.get("inflight_depth") is not None:
+        parts.append(f"inflight {s['inflight_depth']}")
+    if s.get("bound"):
+        parts.append(f"bound {s['bound']}")
+    if s.get("data_health"):
+        parts.append(f"data {s['data_health']['verdict']}")
+    return "  ".join(parts)
+
+
+def render(s: dict, out) -> None:
+    h = s["header"]
+    out.write(f"watch {s['run_id']}  "
+              f"[{h.get('driver', '?')}/{h.get('job', '?')}  "
+              f"backend={h.get('backend', '?')}  "
+              f"map={h.get('map_impl', '?')}]  {s['status'].upper()}\n")
+    out.write(f"  progress: {_mib(s['cursor_bytes'])}")
+    if s.get("total_bytes"):
+        out.write(f" / {_mib(s['total_bytes'])}")
+    if s.get("frac") is not None:
+        out.write(f" ({100 * s['frac']:.1f}%)")
+    if s.get("gb_per_s") is not None:
+        out.write(f"  {s['gb_per_s']:.4f} GB/s")
+    if s.get("eta_s") is not None and s["status"] == "in-flight":
+        out.write(f"  ETA {s['eta_s']:.1f}s")
+    if s.get("inflight_depth") is not None:
+        out.write(f"  inflight {s['inflight_depth']}")
+    out.write(f"  ({s['steps']} steps, {s['groups_retired']} groups"
+              + ("" if s["heartbeat"] else "; no progress records — "
+                 "cursor from step records") + ")\n")
+    if s.get("bound"):
+        out.write(f"  bound so far: {s['bound']} "
+                  f"(from {s['bound_source']})\n")
+    if s.get("data_health"):
+        tag = " (partial: per-group counters)" if s["data_partial"] else ""
+        out.write(f"  data health so far: "
+                  f"{s['data_health']['verdict']}{tag}\n")
+        for f in s["data_health"].get("flags", []):
+            out.write(f"    {f['flag']}: {f['detail']}\n")
+    fl = s.get("fleet")
+    if fl:
+        out.write(f"  fleet so far: {len(fl['hosts'])} hosts, skew "
+                  f"{fl['total_skew_s']:.3f}s over {fl['supersteps']} "
+                  f"supersteps (slowest host {fl['slowest_host']}), "
+                  f"verdict {fl['verdict']}\n")
+
+
+def follow(ledger_path: str, run_id: str | None, interval_s: float,
+           max_seconds: float, out) -> int:
+    """Poll until the watched run completes/crashes or the budget runs
+    out.  One line per observed change; the full block at the end."""
+    deadline = time.monotonic() + max_seconds
+    last_line = None
+    s = None
+    tail = _Tail(ledger_path)
+    while time.monotonic() < deadline:
+        s = snapshot(ledger_path, run_id, records=tail.poll())
+        if s is not None:
+            line = status_line(s)
+            if line != last_line:
+                out.write(f"[{time.strftime('%H:%M:%S')}] {line}\n")
+                out.flush()
+                last_line = line
+            if s["status"] != "in-flight":
+                break
+        time.sleep(interval_s)
+    if s is None:
+        print(f"no records in {ledger_path} within {max_seconds:.0f}s",
+              file=sys.stderr)
+        return 1
+    render(s, out)
+    return 0
+
+
+# -- selftest ----------------------------------------------------------------
+
+def _fixture_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def selftest() -> int:
+    """Snapshot the checked-in fixtures and assert the hand arithmetic:
+    the in-flight heartbeat math, the bound/data-so-far reconstruction,
+    the growing-file replay, graceful pre-v8 degrade, fleet skew, and
+    future-ledger flow-through."""
+    import io
+    import shutil
+    import tempfile
+
+    fdir = _fixture_dir()
+    # In-flight run with heartbeats (watch_ledger.jsonl): 48 MiB of
+    # 128 MiB at 16 MiB/s -> 37.5%, ETA 5.0 s; 3 groups dispatched, 2
+    # retired; the two retired groups' data dicts sum to fallback 2 of 4
+    # chunks -> spill-bound so far; the group timeline is device-bound.
+    s = snapshot(os.path.join(fdir, "watch_ledger.jsonl"))
+    assert s is not None and s["status"] == "in-flight", s
+    assert s["heartbeat"] and s["frac"] == 0.375, s
+    assert s["eta_s"] == 5.0 and s["gb_per_s"] == 0.016777, s
+    assert s["cursor_bytes"] == 50331648, s
+    assert s["total_bytes"] == 134217728, s
+    assert s["bound"] == "device" and s["bound_source"] == "timeline", s
+    assert s["data_partial"] is True
+    assert s["data_so_far"]["fallback_chunks"] == 2, s["data_so_far"]
+    assert s["data_so_far"]["chunks"] == 4, s["data_so_far"]
+    assert s["data_health"]["verdict"] == "spill-bound", s["data_health"]
+    buf = io.StringIO()
+    render(s, buf)
+    body = buf.getvalue()
+    assert "IN-FLIGHT" in body and "(37.5%)" in body, body
+    assert "ETA 5.0s" in body and "bound so far: device" in body, body
+    assert "data health so far: spill-bound (partial" in body, body
+    line = status_line(s)
+    assert "37.5%" in line and "ETA 5.0s" in line, line
+
+    # Growing-file replay: append the fixture line by line (exactly what
+    # a tailer sees while the executor flushes) — the cursor must be
+    # monotone, a torn half-line must parse on the next poll, and the
+    # status must stay in-flight throughout.
+    d = tempfile.mkdtemp(prefix="obswatch_selftest_")
+    try:
+        live = os.path.join(d, "live.jsonl")
+        lines = open(os.path.join(fdir, "watch_ledger.jsonl"),
+                     encoding="utf-8").read().splitlines()
+        cursors = []
+        tail = _Tail(live)  # the follow-mode incremental reader
+        with open(live, "w", encoding="utf-8") as f:
+            for i, ln in enumerate(lines):
+                f.write(ln[:10])  # torn prefix: the reader must skip it
+                f.flush()
+                mid = snapshot(live)
+                # The incremental tail must never consume a torn line.
+                assert len(tail.poll()) == i, (i, len(tail.records))
+                f.write(ln[10:] + "\n")
+                f.flush()
+                g = snapshot(live)
+                if g is not None and g.get("cursor_bytes") is not None:
+                    cursors.append(g["cursor_bytes"])
+                if i and mid is not None:
+                    assert mid["status"] == "in-flight", mid
+        assert cursors == sorted(cursors) and cursors, cursors
+        assert cursors[-1] == 50331648, cursors
+        # The tail converges on exactly the full-read record stream, and
+        # a snapshot over it matches the full-read snapshot.
+        assert tail.poll() == read_ledger(live)
+        ts = snapshot(live, records=tail.poll())
+        assert ts["cursor_bytes"] == 50331648 and ts["frac"] == 0.375, ts
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    # A finished ledger renders the same way (the selector picks the
+    # LAST instance — mini_ledger's is the in-flight fixture10 — and
+    # --run-id picks a finished one).
+    mini = os.path.join(fdir, "mini_ledger.jsonl")
+    tail = snapshot(mini)
+    assert tail["run_id"] == "fixture10", tail
+    assert tail["status"] == "in-flight" and tail["frac"] == 0.5, tail
+    done = snapshot(mini, run_id="fixture05")
+    assert done["status"] == "completed" and done["frac"] == 1.0, done
+    assert done["data_partial"] is False
+    assert done["data_health"]["verdict"] == "spill-bound", done
+    # Pre-v8 graceful degrade: fixture01 predates progress records AND
+    # group records — cursor falls back to the step records, bound to
+    # the phase deltas.
+    old = snapshot(mini, run_id="fixture01")
+    assert old["heartbeat"] is False, old
+    assert old["cursor_bytes"] == 6 * 4 * (1 << 20), old
+    assert old["bound_source"] == "phases" and old["bound"] == "device", old
+    obuf = io.StringIO()
+    render(old, obuf)
+    assert "no progress records" in obuf.getvalue(), obuf.getvalue()
+
+    # Fleet skew so far: the two-host shard fixtures next to
+    # fleet_ledger.jsonl — 2.0 s of skew over 3 supersteps, host 1
+    # slowest, straggler-bound (the fleet selftest's hand numbers).
+    fs = snapshot(os.path.join(fdir, "fleet_ledger.jsonl"))
+    assert fs["fleet"] is not None, fs
+    assert fs["fleet"]["total_skew_s"] == 2.0, fs["fleet"]
+    assert fs["fleet"]["slowest_host"] == 1, fs["fleet"]
+    assert fs["fleet"]["verdict"] == "straggler-bound", fs["fleet"]
+    fbuf = io.StringIO()
+    render(fs, fbuf)
+    assert "fleet so far: 2 hosts, skew 2.000s" in fbuf.getvalue()
+
+    # Forward compat: the future ledger (v99, future-shaped progress
+    # record with unknown fields) snapshots and renders without error.
+    fut = snapshot(os.path.join(fdir, "future_ledger.jsonl"))
+    assert fut["status"] == "completed" and fut["heartbeat"], fut
+    render(fut, io.StringIO())
+
+    print("obswatch selftest ok (in-flight 37.5% ETA 5.0s, device-bound, "
+          "spill-bound-so-far from per-group counters, growing-file "
+          "replay monotone, pre-v8 degrade, fleet skew 2.0s, "
+          "future-ledger ok)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="watch a live (or finished) mapreduce_tpu run ledger")
+    ap.add_argument("ledger", nargs="?", help="JSONL run-ledger path "
+                    "(shards <ledger>.h*.jsonl are discovered)")
+    ap.add_argument("--run-id", default=None,
+                    help="watch this run instead of the last instance")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable snapshot (implies "
+                         "--once)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="follow-mode poll seconds (default 2)")
+    ap.add_argument("--max-seconds", type=float, default=3600.0,
+                    help="follow-mode budget (default 1h)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run against the checked-in fixtures and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.ledger:
+        ap.error("a ledger path (or --selftest) is required")
+    if args.json or args.once:
+        s = snapshot(args.ledger, args.run_id)
+        if s is None:
+            print(f"no records in {args.ledger}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(s, sort_keys=True))
+        else:
+            render(s, sys.stdout)
+        return 0
+    return follow(args.ledger, args.run_id, args.interval,
+                  args.max_seconds, sys.stdout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,13 @@ timeout -k 5 60 python tools/trace_export.py --selftest || { echo "TIER1: trace_
 # fleet_bottleneck verdict asserted against hand arithmetic, merge
 # byte-stability, the pid-per-host trace — jax-free, seconds.
 timeout -k 5 60 python mapreduce_tpu/obs/fleet.py --selftest || { echo "TIER1: fleet selftest FAILED"; exit 1; }
+# Run-history + live-watch gates (ISSUE 14): the warehouse ingest over
+# the checked-in fixture zoo (drift rule table against hand arithmetic,
+# byte-stable re-ingest, resolve_prior parity with the three resolvers
+# it replaced) and the obswatch tailer (in-flight heartbeat math,
+# growing-file replay, pre-v8 degrade, fleet skew) — jax-free, seconds.
+timeout -k 5 60 python mapreduce_tpu/obs/history.py --selftest || { echo "TIER1: history selftest FAILED"; exit 1; }
+timeout -k 5 60 python tools/obswatch.py --selftest || { echo "TIER1: obswatch selftest FAILED"; exit 1; }
 # Autotuner gate (ISSUE 10): the rule-table/search/oscillation-guard walk
 # over the checked-in tuner fixtures, hand-computed targets asserted —
 # also jax-free, seconds.
