@@ -86,6 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: retry a failed device step N times "
                         "from an in-memory known-good snapshot before "
                         "surfacing the failure")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="with --stream: deterministic fault injection at "
+                        "the executor's named seams (runtime/faults.py "
+                        "grammar, e.g. 'seed=42,rate=0.02' or "
+                        "'at=dispatch:3:resource'); every fired fault "
+                        "lands as a `fault` ledger record so the run can "
+                        "be replayed from its own ledger (tools/chaos.py "
+                        "replay). Default from MAPREDUCE_FAULT_PLAN; "
+                        "results stay bit-identical to the fault-free "
+                        "run when the retry budget absorbs the chaos")
     p.add_argument("--distinct-sketch", action="store_true",
                    help="with --stream: carry a HyperLogLog so the distinct "
                         "count stays accurate past table capacity "
@@ -468,6 +478,23 @@ def main(argv: list[str] | None = None) -> int:
                      "step dispatch to retry)")
     if args.retry < 0:
         parser.error(f"--retry must be >= 0, got {args.retry}")
+    if args.fault_plan is None:
+        # The env default binds only to streamed runs: exporting
+        # MAPREDUCE_FAULT_PLAN to chaos-test a service must not turn
+        # every unrelated batch-mode invocation into a hard error.
+        import os as _os
+
+        env_plan = _os.environ.get("MAPREDUCE_FAULT_PLAN") or None
+        if env_plan:
+            if args.stream:
+                args.fault_plan = env_plan
+            else:
+                print("warning: MAPREDUCE_FAULT_PLAN is set but this is "
+                      "not a --stream run; fault injection skipped",
+                      file=sys.stderr)
+    elif not args.stream:
+        parser.error("--fault-plan requires --stream (the injection seams "
+                     "exist only on the streamed path)")
     if args.grep_syntax != "literal" and args.grep is None:
         parser.error("--grep-syntax requires --grep")
     if (args.count_sketch or args.estimate) and args.distinct_sketch:
@@ -559,6 +586,7 @@ def main(argv: list[str] | None = None) -> int:
                         rescue_overlong=args.rescue_overlong,
                         rescue_overlong_max=args.rescue_overlong_max,
                         rescue_window=args.rescue_window,
+                        fault_plan=args.fault_plan,
                         autotune="hint" if args.autotune else "off")
     except ValueError as e:
         parser.error(str(e))
@@ -685,6 +713,17 @@ def main(argv: list[str] | None = None) -> int:
                                 telemetry=tel)
         return _wordcount_main(args, paths, data, config, input_bytes,
                                telemetry=tel)
+    except Exception as e:
+        # Orderly preemption shutdown (ISSUE 15): the stream drained and
+        # (when configured) checkpointed before raising — a clean
+        # one-line exit with the resume cursor, not a crash traceback.
+        # Exit 75 (EX_TEMPFAIL): relaunch the same command to resume.
+        from mapreduce_tpu.runtime import faults as faults_mod
+
+        if not isinstance(e, faults_mod.Preempted):
+            raise
+        print(f"preempted: {e}", file=sys.stderr)
+        return 75
     finally:
         if tel is not None:
             if args.metrics_out:

@@ -481,6 +481,29 @@ class Config:
     # margin.  Adversarial all-overlong text can still exceed it; the
     # residual stays exactly accounted in dropped_*, as ever.
     rescue_overlong_max: Optional[int] = None
+    # Deterministic fault injection (ISSUE 15): a seeded FaultPlan spec
+    # string (runtime/faults.py grammar — e.g. 'seed=42,rate=0.02' or
+    # 'at=dispatch:3:resource') fired at the executor's named seams
+    # (reader read, staging, H2D, dispatch, token wait, checkpoint save,
+    # ledger append, collective finish, process kill).  Every fired fault
+    # lands as a `fault` ledger record (ledger v9), so a chaotic run can
+    # be replayed exactly from its own ledger
+    # (faults.FaultPlan.from_ledger).  None (default) is the provably
+    # zero-cost disabled path: the executor guards every seam check with
+    # one `is not None`, nothing is traced either way, and the compiled
+    # programs are bit-identical to fault-plan-free builds.  Host-side
+    # only — injection never reaches a jitted program.
+    fault_plan: Optional[str] = None
+    # Unified failure policy (ISSUE 15): None (default) maps the driver's
+    # legacy `retry=N` counter onto transient+resource budgets (the exact
+    # pre-ISSUE-15 semantics); a faults.FailurePolicy (or a dict of its
+    # fields) sets per-class retry budgets, the exponential-backoff +
+    # deterministic-jitter schedule, the completion-token wall-clock
+    # timeout (a hung device reads as a typed fault instead of a silent
+    # stall), and whether resource-classed exhaustion steps down the
+    # degradation ladder (revert-geometry -> combiner-off -> map-split ->
+    # sort-xla) before giving up.
+    failure_policy: object = None
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
@@ -618,6 +641,30 @@ class Config:
             raise ValueError(
                 f"pallas backend needs chunk_bytes <= {1 << 26} (64 MB), "
                 f"got {self.chunk_bytes}")
+        if self.fault_plan is not None or self.failure_policy is not None:
+            # Validate at construction, not mid-stream (the geometry-dict
+            # discipline); runtime/faults.py is jax-free and cheap.
+            from mapreduce_tpu.runtime import faults as faults_mod
+
+            if self.fault_plan is not None:
+                if not isinstance(self.fault_plan, str):
+                    raise ValueError(
+                        f"fault_plan must be a spec string (or None), got "
+                        f"{type(self.fault_plan).__name__}")
+                faults_mod.FaultPlan.from_spec(self.fault_plan)
+            if isinstance(self.failure_policy, dict):
+                # Accept plain dicts (JSON-shaped) but STORE the frozen
+                # dataclass: Config is hashable (a static jit argument),
+                # so the field must be too (the geometry precedent).
+                object.__setattr__(self, "failure_policy",
+                                   faults_mod.FailurePolicy(
+                                       **self.failure_policy))
+            elif self.failure_policy is not None and not isinstance(
+                    self.failure_policy, faults_mod.FailurePolicy):
+                raise ValueError(
+                    f"failure_policy must be None, a FailurePolicy or a "
+                    f"dict of its fields, got "
+                    f"{type(self.failure_policy).__name__}")
 
     @property
     def rescue_slots(self) -> int:

@@ -244,6 +244,103 @@ def classify_fleet(per_host: dict) -> dict:
     return {"verdict": verdict, "flags": flags, "signals": signals}
 
 
+#: Reliability verdict priority (ISSUE 15): highest-severity wins, the
+#: :func:`classify` rule-table discipline.  A `failed` run died; a
+#: `preempted` run exited cleanly with a resumable cursor; a `degraded`
+#: run finished on a stepped-down config (alive but slower — visible,
+#: not mysterious); a `fault-prone` run absorbed real faults with
+#: retries; a `chaos-tested` run absorbed only INJECTED faults (a chaos
+#: certification run that stayed exact).
+RELIABILITY_ORDER = ("failed", "preempted", "degraded", "fault-prone",
+                     "chaos-tested", "clean")
+
+
+def classify_reliability(records: Iterable[dict],
+                         run_id: Optional[str] = None) -> dict:
+    """One run's ledger records -> the reliability verdict (ISSUE 15,
+    ledger v9): ``{verdict, flags, signals}`` over the run's ``fault`` /
+    ``degrade`` / ``retry`` / ``failure`` records.  Unknown kinds and
+    extra fields skip (forward compat); a pre-v9 ledger with none of
+    these kinds reads ``clean`` — exactly what it observed."""
+    chosen = run_id
+    faults: list = []
+    degrades: list = []
+    retries_by_class: dict = {}
+    failures = 0
+    preempted = False
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind not in ("fault", "degrade", "retry", "failure",
+                        "checkpoint"):
+            continue
+        if chosen is None:
+            chosen = rec.get("run_id")
+        if chosen is not None and rec.get("run_id") not in (None, chosen):
+            continue
+        if kind == "fault":
+            faults.append(rec)
+            if rec.get("fault_class") == "preemption":
+                preempted = True
+        elif kind == "degrade":
+            degrades.append(rec)
+        elif kind == "retry":
+            cls = rec.get("fault_class") or "transient"
+            retries_by_class[cls] = retries_by_class.get(cls, 0) + 1
+        elif kind == "failure":
+            failures += 1
+        elif kind == "checkpoint" and rec.get("preempt"):
+            preempted = True
+    injected = [f for f in faults if f.get("injected")]
+    real = [f for f in faults if not f.get("injected")]
+    seams: dict = {}
+    for f in faults:
+        s = f.get("seam") or "?"
+        seams[s] = seams.get(s, 0) + 1
+    signals = {
+        "faults_total": len(faults),
+        "faults_injected": len(injected),
+        "faults_real": len(real),
+        "retries": sum(retries_by_class.values()),
+        "retries_by_class": retries_by_class,
+        "failures": failures,
+        "degrade_steps": [d.get("ladder_step") for d in degrades],
+        "fault_seams": seams,
+    }
+    flags = []
+
+    def flag(name: str, detail: str) -> None:
+        flags.append({"flag": name, "detail": detail})
+
+    if failures:
+        flag("failed", f"{failures} failure record(s): the run surfaced "
+             "an unrecoverable fault — see the flight dump")
+    if preempted:
+        flag("preempted", "the platform reclaimed the machine; the run "
+             "drained, checkpointed and exited with a resumable cursor")
+    if degrades:
+        steps = " -> ".join(str(s) for s in signals["degrade_steps"])
+        flag("degraded",
+             f"resource exhaustion stepped down the degradation ladder "
+             f"({steps}): the run finished on a cheaper config — slower, "
+             "never wrong (each step is bit-identity-tested)")
+    if real:
+        flag("fault-prone",
+             f"{len(real)} real fault(s) classified at seams "
+             f"{sorted({f.get('seam') for f in real})} and absorbed by "
+             f"{signals['retries']} retr(ies) — watch the trend in the "
+             "run-history warehouse")
+    if injected:
+        flag("chaos-tested",
+             f"{len(injected)} injected fault(s) fired from the run's "
+             "fault plan; results certified bit-identical when the "
+             "retry budget absorbed them")
+    fired = {f["flag"] for f in flags}
+    verdict = next((v for v in RELIABILITY_ORDER if v in fired), "clean")
+    return {"verdict": verdict, "flags": flags, "signals": signals}
+
+
 def resolve_combiner(records: Iterable[dict]) -> str:
     """Resolve ``Config.combiner='auto'`` against a prior run's ledger
     (ISSUE 11): the most recent ``data`` record's verdict decides —

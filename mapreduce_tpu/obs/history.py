@@ -800,10 +800,11 @@ def selftest() -> int:
         assert dig["data_verdict"] == "skew-hot", dig
         assert dig["phase_shares"], dig
 
-        # --- the whole fixture zoo ingests: mini (9 instances incl. the
-        # in-flight v8 fixture10), the clean counterpart, the two-host
-        # fleet shards (fleet verdict attached), the future ledger
-        # (unknown kinds/fields skip-or-consume, never an error).
+        # --- the whole fixture zoo ingests: mini (10 instances incl. the
+        # in-flight v8 fixture10 and the v9 chaotic fixture11), the clean
+        # counterpart, the two-host fleet shards (fleet verdict
+        # attached), the future ledger (unknown kinds/fields
+        # skip-or-consume, never an error).
         z = tempfile.mkdtemp(prefix="history_zoo_")
         try:
             zidx = ingest([os.path.join(fdir, "mini_ledger.jsonl"),
@@ -813,8 +814,11 @@ def selftest() -> int:
             zrows = sorted(zidx["runs"].values(), key=_row_order)
             by_run = {r["run_id"]: r for r in zrows}
             assert len([r for r in zrows
-                        if r["source"] == "mini_ledger.jsonl"]) == 9
+                        if r["source"] == "mini_ledger.jsonl"]) == 10
             assert by_run["fixture10"]["completed"] is False
+            # The v9 chaotic run (ISSUE 15): fault/degrade records skip-
+            # or-consume through ingest; the run digests as completed.
+            assert by_run["fixture11"]["completed"] is True
             zdig = read_digest(z, by_run["fixture10"]["id"])
             assert zdig["progress"]["frac"] == 0.5, zdig["progress"]
             assert by_run["fleet01"]["fleet_bottleneck"] \
@@ -884,7 +888,7 @@ def selftest() -> int:
         shutil.rmtree(d, ignore_errors=True)
     print("history selftest ok (6 fixture runs, regressing/config-drift/"
           "improving/steady/no-history verdicts, streak 4, byte-stable "
-          "re-ingest, 9-instance mini zoo + fleet + future flow-through, "
+          "re-ingest, 10-instance mini zoo + fleet + future flow-through, "
           "resolve_prior parity x4)")
     return 0
 

@@ -119,8 +119,16 @@ from typing import Iterator, Optional
 #: in-flight depth, throughput-so-far and the ETA derived from the byte
 #: cursor), flushed like every record so ``tools/obswatch.py`` can tail
 #: a run that has not ended — and ``obs/history.py`` can digest crashed
-#: runs up to their last heartbeat.
-LEDGER_VERSION = 8
+#: runs up to their last heartbeat;
+#: 9 = robustness (ISSUE 15): typed ``fault`` records (seam,
+#: fault_class, injected, crossing index — a chaotic run's own replayable
+#: schedule via ``runtime/faults.FaultPlan.from_ledger``), ``degrade``
+#: records (one per degradation-ladder step: ladder_step, field,
+#: from/to), ``retry``/``failure`` records gain ``fault_class`` (+
+#: ``seam`` on non-dispatch retries), and run_start stamps the
+#: ``fault_plan`` spec on chaos runs.  Fault-free runs emit no new
+#: records and no new fields beyond the version stamp.
+LEDGER_VERSION = 9
 
 
 def shard_path(path: str, process_index: int) -> str:

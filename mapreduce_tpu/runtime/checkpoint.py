@@ -33,6 +33,15 @@ class CheckpointMismatch(RuntimeError):
     """The checkpoint was produced by an incompatible run configuration."""
 
 
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint file is torn or fails its integrity checksum
+    (ISSUE 15 satellite): the bytes on disk are not the bytes that were
+    written — a crash mid-save, bit rot, a truncating filesystem.
+    Distinct from :class:`CheckpointMismatch` (a semantically DIFFERENT
+    run's valid snapshot): corruption falls back to the previous good
+    snapshot (:func:`load_resilient`), mismatch never does."""
+
+
 def run_fingerprint(input_path: str, n_devices: int, chunk_bytes: int,
                     backend: str = "xla", pallas_max_token: int = 0,
                     byte_range: tuple[int, int] | None = None,
@@ -120,7 +129,15 @@ def save(path: str, state: Any, step: int, offset: int,
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
+        # Integrity (ISSUE 15 satellite): checksum the snapshot as
+        # written, BEFORE it becomes the live checkpoint, and keep the
+        # previous good snapshot as `.prev` — a torn/corrupt file at
+        # resume falls back to it instead of crashing the relaunch.
+        digest, nbytes = _file_sha256(tmp)
+        if os.path.exists(path):
+            _rotate_previous(path)
         os.replace(tmp, path)
+        _write_integrity(path, digest, nbytes)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -217,4 +234,141 @@ def load(path: str, template: Any = None,
 
 
 def exists(path: str) -> bool:
-    return os.path.exists(path)
+    """Resume gate: True when a resumable snapshot is present — the live
+    ``path``, or only the previous good ``.prev`` (a crash landed inside
+    :func:`save`'s rename-fallback rotation, leaving ``path`` absent);
+    :func:`load_resilient` then loads whichever is intact."""
+    return os.path.exists(path) or os.path.exists(previous_path(path))
+
+
+# -- snapshot integrity (ISSUE 15 satellite) ---------------------------------
+
+
+def integrity_path(path: str) -> str:
+    """The checksum sidecar next to a snapshot: ``ck.npz`` ->
+    ``ck.npz.sum`` (JSON: sha256, bytes, format)."""
+    return path + ".sum"
+
+
+def previous_path(path: str) -> str:
+    """The previous good snapshot, rotated aside by :func:`save`."""
+    return path + ".prev"
+
+
+def _rotate_previous(path: str) -> None:
+    """Rotate the live snapshot (and its sidecar) aside to ``.prev``
+    without ever leaving ``path`` empty: hard-link the current inode to
+    a temp name and rename the link over ``.prev``, so the caller's
+    final rename of the new snapshot over ``path`` is the only mutation
+    of ``path`` — a hard kill anywhere in the sequence leaves a loadable
+    snapshot at ``path``.  Where the filesystem refuses hard links,
+    falls back to the rename rotation, whose crash window (``path``
+    absent, good ``.prev``) is covered by :func:`exists` and
+    :func:`load_resilient` consulting ``.prev``.
+
+    The ``.sum`` sidecar rotates by RENAME deliberately: a missing
+    sidecar is safe (:func:`verify` -> None, the snapshot still loads)
+    but a stale one is not — were the old sidecar left at
+    ``integrity_path(path)``, a kill between the caller's npz rename
+    and its new-sidecar write would pair the NEW snapshot with the OLD
+    digest, and a perfectly good checkpoint would read as corrupt."""
+    prev = previous_path(path)
+    tmp_link = prev + ".tmp"
+    try:
+        if os.path.exists(tmp_link):
+            os.unlink(tmp_link)
+        os.link(path, tmp_link)
+        os.replace(tmp_link, prev)
+    except OSError:
+        os.replace(path, prev)
+    if os.path.exists(integrity_path(path)):
+        os.replace(integrity_path(path), integrity_path(prev))
+
+
+def _file_sha256(path: str) -> tuple[str, int]:
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            h.update(block)
+            n += len(block)
+    return h.hexdigest(), n
+
+
+def _write_integrity(path: str, digest: str, nbytes: int) -> None:
+    """Atomic sidecar write (tmp + rename, like the snapshot itself)."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".sum.tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"sha256": digest, "bytes": nbytes,
+                       "format": _FORMAT}, f)
+        os.replace(tmp, integrity_path(path))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def verify(path: str) -> bool | None:
+    """Checksum a snapshot against its sidecar: True (intact), False
+    (torn/corrupt — size or sha256 mismatch, unreadable sidecar), or
+    None when no sidecar exists (a pre-integrity snapshot: unknown, and
+    :func:`load_verified` falls back to np.load being able to parse it)."""
+    sp = integrity_path(path)
+    if not os.path.exists(sp):
+        return None
+    try:
+        with open(sp, encoding="utf-8") as f:
+            want = json.load(f)
+        digest, nbytes = _file_sha256(path)
+        return digest == want.get("sha256") and nbytes == want.get("bytes")
+    except (OSError, ValueError):
+        return False
+
+
+def load_verified(path: str, template: Any = None,
+                  expect_fingerprint: dict | None = None):
+    """:func:`load` behind the integrity gate: a failing checksum — or a
+    file so torn np.load cannot parse it — raises
+    :class:`CheckpointCorrupt` (never a raw zipfile/OS error), while
+    semantic rejections stay :class:`CheckpointMismatch`."""
+    if verify(path) is False:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} fails its integrity checksum "
+            f"({integrity_path(path)}): the file on disk is not the file "
+            "that was saved")
+    try:
+        return load(path, template=template,
+                    expect_fingerprint=expect_fingerprint)
+    except CheckpointMismatch:
+        raise
+    except Exception as e:  # torn zip/npz, short read, bad member
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e}); "
+            "likely torn by a crash mid-save") from e
+
+
+def load_resilient(path: str, template: Any = None,
+                   expect_fingerprint: dict | None = None):
+    """Resume read with the previous-good fallback (ISSUE 15 satellite):
+    returns ``(load-result-tuple, fallback)`` where ``fallback`` is None
+    on the happy path, or a dict naming the corrupt file and the ``.prev``
+    snapshot actually loaded.  Raises :class:`CheckpointCorrupt` only
+    when the previous snapshot is also missing/corrupt (the caller then
+    chooses between deleting the checkpoint and restarting)."""
+    try:
+        return (load_verified(path, template=template,
+                              expect_fingerprint=expect_fingerprint), None)
+    except CheckpointCorrupt as e:
+        prev = previous_path(path)
+        if not os.path.exists(prev):
+            raise
+        result = load_verified(prev, template=template,
+                               expect_fingerprint=expect_fingerprint)
+        reg = obs_registry.get_registry()
+        reg.counter("checkpoint.corrupt_fallbacks").inc()
+        return (result, {"corrupt": path, "loaded": prev, "error": str(e)})

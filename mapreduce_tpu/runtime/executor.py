@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 import time
 import weakref
 from typing import Any, Optional
@@ -30,6 +31,7 @@ from mapreduce_tpu import constants
 from mapreduce_tpu import obs
 from mapreduce_tpu.config import Config, DEFAULT_CONFIG
 from mapreduce_tpu.data import reader as reader_mod
+from mapreduce_tpu.runtime import faults as faults_mod
 from mapreduce_tpu.models.wordcount import (WordCountJob, TopKWordCountJob,
                                             NGramCountJob, TopKTable,
                                             SketchedState, SketchedWordCountJob,
@@ -171,6 +173,13 @@ class _StreamHooks:
     # staged, the per-host balance signal obs/fleet.py reads (group_bytes
     # is the GLOBAL batch size, identical on every process).
     host_rows: Any = None
+    # Degradation ladder (ISSUE 15): Config -> fresh Engine for a
+    # degraded config.  The ladder only moves knobs that keep state
+    # shapes (and results — each is bit-identical-tested) intact, so the
+    # anchor snapshot restages into the rebuilt engine unchanged.  None
+    # (run_job_global) disables the ladder: resource exhaustion there
+    # fails over to checkpoint/resume like every other global failure.
+    rebuild: Any = None
 
 
 class _StagePool:
@@ -231,6 +240,16 @@ def _probe_body(leaf):
 
 _probe_jit = jax.jit(_probe_body)
 
+#: Barrier-copy for any state about to enter the DONATING step programs.
+#: A state built by ``jax.device_put`` (replay restage, checkpoint resume)
+#: must not be donated as-is: donating a transfer-created buffer corrupts
+#: the process heap on the CPU backend (glibc double-free aborts — the
+#: chaos harness's token-wait plans reproduce it deterministically; an
+#: XLA-produced buffer is donation-safe).  ``optimization_barrier`` is a
+#: real equation, so jit cannot prune it to a pass-through and the output
+#: is a fresh XLA-owned allocation with the input's sharding.
+_owned_state = jax.jit(jax.lax.optimization_barrier)
+
 
 def _state_token(state):
     """Per-group completion token: the smallest state leaf, copied through
@@ -248,6 +267,154 @@ def _wait_token(token) -> None:
     fetch (the CPU backend executes callbacks at dispatch, so the real
     late-surfacing failure mode cannot be produced natively here)."""
     jax.block_until_ready(token)
+
+
+def _wait_token_timed(token, timeout_s: float) -> None:
+    """:func:`_wait_token` under a wall-clock deadline
+    (``FailurePolicy.token_timeout_s``, ISSUE 15): a wait past the
+    deadline raises a typed :class:`...runtime.faults.TokenTimeout`
+    (transient — the replay path re-dispatches from the window anchor)
+    instead of stalling the driver forever on a hung device or wedged
+    relay link.  ``jax.block_until_ready`` has no timeout of its own, so
+    the wait runs on a daemon worker thread; an abandoned wait costs one
+    parked thread, which the recovery replay's fresh dispatch obsoletes."""
+    if not timeout_s:
+        return _wait_token(token)
+    box: list = []
+
+    def run() -> None:
+        try:
+            _wait_token(token)
+            box.append(None)
+        except BaseException as e:  # surfaced at the fetch: deliver as-is
+            box.append(e)
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="mapreduce-token-wait")
+    t.start()
+    t.join(timeout_s)
+    if not box:
+        raise faults_mod.TokenTimeout(
+            f"completion token not ready within {timeout_s}s "
+            "(hung device or wedged relay link)", seam="token-wait")
+    if box[0] is not None:
+        raise box[0]
+
+
+def _record_fault(tel, write: bool, exc: BaseException, *, seam: str,
+                  injected: bool, index: Optional[int] = None,
+                  step: Optional[int] = None) -> str:
+    """One typed-fault observation (ISSUE 15, ledger v9): the taxonomy
+    class lands in the ``executor.faults`` registry counter, the flight
+    ring, and a ``fault`` ledger record.  The ledger write is
+    best-effort — the ledger may be the very seam that is failing — and
+    a fault record must never mask the fault itself.  Returns the class."""
+    cls = faults_mod.classify(exc)
+    tel.registry.counter("executor.faults", seam=seam, fault_class=cls).inc()
+    tel.event("fault", seam=seam, fault_class=cls, injected=injected,
+              error=repr(exc))
+    try:
+        rec: dict = {"seam": seam, "fault_class": cls, "injected": injected,
+                     "error": repr(exc)}
+        if index is not None:
+            rec["index"] = int(index)
+        if step is not None:
+            rec["step"] = int(step)
+        tel.ledger_write("fault", write=write, **rec)
+    except Exception:
+        pass
+    return cls
+
+
+class _DegradeSignal(Exception):
+    """Internal: a resource-classed failure exhausted its budget inside
+    the recovery replay and the degradation ladder may still have a step
+    — ``recover()``'s ladder loop owns the decision."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def _config_summary(config: Config) -> dict:
+    """The degradation ladder's view of a config (label values only;
+    ``faults.next_degrade`` consumes exactly this shape)."""
+    return {"geometry": config.geometry_label,
+            "combiner": config.resolved_combiner,
+            "map_impl": config.map_impl,
+            "sort_impl": config.sort_impl}
+
+
+def _apply_degrade(config: Config, field: str, value: str) -> Config:
+    """One ladder step applied to the real Config.  revert-geometry maps
+    to the None sentinel (the shipped constants); combiner-off also drops
+    the cache sizing knob, which only validates with the cache on."""
+    if field == "geometry":
+        return dataclasses.replace(config, geometry=None)
+    kw: dict = {field: value}
+    if field == "combiner":
+        kw["combiner_slots"] = None
+    return dataclasses.replace(config, **kw)
+
+
+def _job_with_config(job, config: Config):
+    """Shallow-rebind a job's Config for a degradation-ladder step.  The
+    ladder moves only knobs that leave state SHAPES untouched (geometry/
+    combiner/map_impl/sort_impl swap kernels, not pytrees — each shipped
+    with a bit-identity suite), so a copied job with the degraded config
+    drives the same state through cheaper programs.  Composed jobs
+    (sketch wrappers) rebind their base job too."""
+    import copy
+
+    j = copy.copy(job)
+    base = getattr(j, "base", None)
+    if base is not None:
+        j.base = _job_with_config(base, config)
+    if hasattr(j, "config"):
+        j.config = config
+    return j
+
+
+def _collective_finish(engine, state, plan, policy, tel, write: bool,
+                       logger):
+    """``engine.finish`` behind the collective-finish seam (ISSUE 15).
+
+    Injected faults fire BEFORE the finish runs, so retrying them on the
+    transient/resource budget is always safe; a real collective failure
+    is classified + recorded and propagates — in a fleet, peer processes
+    are blocked mid-program, and checkpoint/resume is the recovery path
+    (the run_job_global no-retry contract)."""
+    attempt = 0
+    while True:
+        try:
+            if plan is not None:
+                exc = plan.check("collective-finish")
+                if exc is not None:
+                    _record_fault(tel, write, exc, seam="collective-finish",
+                                  injected=True, index=exc.index)
+                    raise exc
+            return engine.finish(state)
+        except faults_mod.FaultError as fe:
+            if not fe.injected or fe.fault_class == "preemption":
+                raise
+            if attempt >= policy.budget(fe.fault_class):
+                raise
+            attempt += 1
+            tel.registry.counter("executor.retry_attempts").inc()
+            tel.registry.counter("executor.retries_by_class",
+                                 fault_class=fe.fault_class).inc()
+            tel.ledger_write("retry", attempt=attempt, error=repr(fe),
+                             fault_class=fe.fault_class,
+                             seam="collective-finish", write=write)
+            log_event(logger, "collective finish fault; retrying",
+                      attempt=attempt, fault_class=fe.fault_class)
+            s = policy.backoff_s(fe.fault_class, attempt,
+                                 seam="collective-finish")
+            if s > 0:
+                time.sleep(s)
+        except Exception as e:
+            _record_fault(tel, write, e, seam="collective-finish",
+                          injected=False)
+            raise
 
 
 @dataclasses.dataclass
@@ -352,7 +519,7 @@ def _drive_stream(engine, job, config: Config, path, state,
                   end_offset, bases_list: list, checkpoint_path,
                   checkpoint_every: int, fingerprint, resumed_file,
                   logger, progress_every: int, timer=None, telemetry=None,
-                  data_agg=None):
+                  data_agg=None, plan=None, policy=None):
     """The shared streaming loop: reader -> prefetch -> superstep groups ->
     a bounded in-flight dispatch window (ISSUE 5), with checkpoint cadence
     and file-boundary hooks.  Returns ``(state, bytes_done, step_index,
@@ -427,6 +594,15 @@ def _drive_stream(engine, job, config: Config, path, state,
     pending: list = []
     timer = timer if timer is not None else metrics_mod.PhaseTimer()
     tel = obs.maybe(telemetry)
+    # Unified failure policy + fault plan (ISSUE 15).  `plan is None` is
+    # the provably zero-cost disabled path: every seam check below is
+    # guarded by that one identity test, and nothing here is traced.
+    # `cur_config` is the degradation ladder's moving target — the ladder
+    # only moves kernel-choice knobs, so the loop's own reads of `config`
+    # (superstep, window, prefetch) stay pinned to what the caller set.
+    policy = policy if policy is not None \
+        else faults_mod.FailurePolicy.resolve(None, retry=hooks.retry)
+    cur_config = config
     window_cap = max(1, config.inflight_groups)
     window: collections.deque = collections.deque()
     # retry > 0: host snapshot of the state at the current anchor point —
@@ -468,11 +644,42 @@ def _drive_stream(engine, job, config: Config, path, state,
                      inflight_depth=len(window),
                      write=hooks.write_gate())
 
+    def cross(seam):
+        """One named seam crossing of the fault plan (ISSUE 15; call
+        sites guard on ``plan is not None``): count it, and when the plan
+        says this crossing fails, record the typed ``fault`` ledger
+        record and raise.  ``process-kill`` is not an exception — it is
+        the machine going away (``os._exit``, no cleanup, no flush beyond
+        the already-flushed ledger): the multi-host chaos scenario."""
+        exc = plan.check(seam)
+        if exc is None:
+            return
+        _record_fault(tel, hooks.write_gate(), exc, seam=seam,
+                      injected=True, index=exc.index, step=step_index)
+        if seam == "process-kill":
+            import os
+
+            os._exit(113)
+        raise exc
+
+    def backoff(fault_class, attempt, seam):
+        """Policy backoff before retry ``attempt`` (exponential +
+        deterministic jitter — runtime/faults.py owns the formula)."""
+        s = policy.backoff_s(fault_class, attempt, seam=seam)
+        if s > 0:
+            time.sleep(s)
+
     def dispatch(state, group):
         with obs.span("stage", timer):
+            if plan is not None:
+                cross("stage-acquire")
             staged = hooks.stage_single(group[0]) if len(group) == 1 \
                 else hooks.stage_group(group)
+            if plan is not None:
+                cross("h2d")
         with obs.span("dispatch", timer):
+            if plan is not None:
+                cross("dispatch")
             if len(group) == 1:
                 out = engine.step(state, staged, group[0].step)
             else:
@@ -513,7 +720,8 @@ def _drive_stream(engine, job, config: Config, path, state,
             subs.append(cur)
         return subs
 
-    def final_failure(e, step, attempts, snapshot=None, cursor=None):
+    def final_failure(e, step, attempts, snapshot=None, cursor=None,
+                      fault_class=None):
         """Failure detection (SURVEY §5): out of retries (or none
         requested).  Surface loudly with the resume cursor;
         checkpoint/resume is the recovery path.  The flight recorder dumps
@@ -524,44 +732,62 @@ def _drive_stream(engine, job, config: Config, path, state,
         failure leaves forensics from the host that actually failed
         instead of being swallowed by the write gate — N processes no
         longer race one file); the failure record rides the gate into
-        the main ledger and lands in the per-host shard regardless."""
+        the main ledger and lands in the per-host shard regardless.
+        ``fault_class`` (ISSUE 15): the taxonomy class the policy decided
+        on, stamped into the failure record."""
         cursor = bytes_done if cursor is None else cursor
+        fault_class = fault_class or faults_mod.classify(e)
         tel.event("step_failed", step=step, attempt=attempts - 1,
                   error=repr(e))
         dump = tel.flight_dump(
             context={"step": step, "offset": cursor,
                      "attempts": attempts, "error": repr(e),
+                     "fault_class": fault_class,
                      "checkpoint_path": checkpoint_path},
             state=snapshot)
         tel.ledger_write("failure", step=step, cursor_bytes=cursor,
-                         error=repr(e), flight_dump=dump,
-                         write=hooks.write_gate())
+                         error=repr(e), fault_class=fault_class,
+                         flight_dump=dump, write=hooks.write_gate())
         log_event(logger, "step failed", step=step, offset=cursor,
+                  fault_class=fault_class,
                   resume_hint=checkpoint_path
                   or "enable checkpointing to resume")
         raise e
 
-    def retry_record(step, attempt, e):
+    def retry_record(step, attempt, e, fault_class="transient", seam=None):
         tel.registry.counter("executor.retry_attempts").inc()
+        # Satellite (ISSUE 15): per-class retry accounting in the
+        # registry — the service-level "how flaky is this fleet" signal.
+        tel.registry.counter("executor.retries_by_class",
+                             fault_class=fault_class).inc()
         tel.event("retry", step=step, attempt=attempt, error=repr(e))
-        tel.ledger_write("retry", step=step, attempt=attempt,
-                         error=repr(e), write=hooks.write_gate())
+        rec = {"step": step, "attempt": attempt, "error": repr(e),
+               "fault_class": fault_class}
+        if seam:
+            rec["seam"] = seam
+        tel.ledger_write("retry", write=hooks.write_gate(), **rec)
         log_event(logger, "step failed; retrying", step=step,
-                  attempt=attempt)
+                  attempt=attempt, fault_class=fault_class)
 
     def serial_dispatch(state, group, attempts_used=0, used_out=None,
-                        cursor=None):
+                        cursor=None, charged_class="transient"):
         """The serialized dispatch: snapshot -> dispatch -> block, retrying
-        from the snapshot on failure — the window's recovery path (and the
-        exact pre-window semantics).  ``attempts_used`` pre-charges the
-        attempt the failed group already burned inside the window;
-        ``used_out`` (a 1-slot list) reports the final attempt count;
-        ``cursor`` is the stream offset BEFORE this group, so a replay
-        that exhausts its retries reports an honest failure cursor
-        (``bytes_done`` already includes later groups accounted at their
-        original dispatch)."""
+        from the snapshot on failure under the PER-CLASS policy budgets
+        (ISSUE 15) — the window's recovery path (and the exact pre-window
+        semantics when the policy is the legacy ``retry=N`` mapping).
+        ``attempts_used`` pre-charges the attempt the failed group already
+        burned inside the window, against ``charged_class``; ``used_out``
+        (a 1-slot list) reports the final total attempt count; ``cursor``
+        is the stream offset BEFORE this group, so a replay that exhausts
+        its retries reports an honest failure cursor (``bytes_done``
+        already includes later groups accounted at their original
+        dispatch).  A resource-classed exhaustion raises
+        :class:`_DegradeSignal` when the ladder can still step down —
+        ``recover()``'s ladder loop owns that choice."""
         snapshot = hooks.snapshot(state)
-        attempt = attempts_used
+        used = {c: 0 for c in faults_mod.FAULT_CLASSES}
+        used[charged_class] = attempts_used
+        total = attempts_used
         while True:
             staged = None
             try:
@@ -571,7 +797,7 @@ def _drive_stream(engine, job, config: Config, path, state,
                 if hooks.stage_release is not None:
                     hooks.stage_release(staged)
                 if used_out is not None:
-                    used_out[0] = attempt
+                    used_out[0] = total
                 return out, stats
             except Exception as e:
                 # Return the failed attempt's staging buffer so its id
@@ -579,11 +805,26 @@ def _drive_stream(engine, job, config: Config, path, state,
                 # it — harmless, its output is discarded).
                 if staged is not None and hooks.stage_release is not None:
                     hooks.stage_release(staged)
-                if attempt >= hooks.retry:
-                    final_failure(e, group[0].step, attempts=attempt + 1,
-                                  snapshot=snapshot, cursor=cursor)
-                attempt += 1
-                retry_record(group[0].step, attempt, e)
+                cls = faults_mod.classify(e)
+                if cls == "preemption":
+                    raise
+                if not (isinstance(e, faults_mod.FaultError)
+                        and e.injected):
+                    _record_fault(tel, hooks.write_gate(), e,
+                                  seam=getattr(e, "seam", None)
+                                  or "dispatch",
+                                  injected=False, step=group[0].step)
+                if used[cls] >= policy.budget(cls):
+                    if cls == "resource" and policy.degrade \
+                            and hooks.rebuild is not None:
+                        raise _DegradeSignal(e)
+                    final_failure(e, group[0].step, attempts=total + 1,
+                                  snapshot=snapshot, cursor=cursor,
+                                  fault_class=cls)
+                used[cls] += 1
+                total += 1
+                retry_record(group[0].step, total, e, fault_class=cls)
+                backoff(cls, used[cls], "dispatch")
                 # Transient-failure recovery: rebuild a fresh sharded state
                 # from the snapshot and re-dispatch the same host batches.
                 state = hooks.restage(snapshot)
@@ -602,24 +843,48 @@ def _drive_stream(engine, job, config: Config, path, state,
         dispatch order, so it is provably the EARLIEST failure) or raised
         by the dispatch call itself (``sync_group``: dispatched but never
         accounted).  Attribution is to that group's first step, never to
-        whichever later group happened to block first.  With retry budget:
-        replay every group since the anchor snapshot serially — groups
-        before the failure re-dispatch free (they completed, but the anchor
-        is their only rebuild point), the failed group is charged one
-        attempt."""
-        nonlocal retired_groups
+        whichever later group happened to block first.
+
+        ISSUE 15: the exception is CLASSIFIED first (transient / resource
+        / permanent / preemption) and the class decides the outcome —
+        preemption re-raises to the stream-level drain-checkpoint-exit
+        handler (the window is healthy, the signal is not a device
+        error); permanent fails immediately; transient/resource replay
+        every group since the anchor snapshot serially on their per-class
+        budgets (groups before the failure re-dispatch free — the anchor
+        is their only rebuild point — and the failed group is charged one
+        attempt); a resource-classed budget exhaustion steps down the
+        degradation ladder (rebuild the engine on a cheaper config,
+        replay again) until the ladder runs out."""
+        nonlocal retired_groups, engine, cur_config
+        cls = faults_mod.classify(e)
         fail_step = (entry.step_first if entry is not None
                      else sync_group[0].step)
+        if not (isinstance(e, faults_mod.FaultError) and e.injected):
+            _record_fault(tel, hooks.write_gate(), e,
+                          seam=getattr(e, "seam", None)
+                          or ("token-wait" if entry is not None
+                              else "dispatch"),
+                          injected=False, step=fail_step)
+        if cls == "preemption":
+            # Not a device error: the window's other groups are healthy
+            # and an unenrolled sync group simply replays after resume.
+            raise e
         cursor = entry.cursor_before if entry is not None else bytes_done
-        if hooks.retry <= 0 or hooks.restage is None:
-            final_failure(e, fail_step, attempts=1, cursor=cursor)
+        budget = policy.budget(cls)
+        can_ladder = (cls == "resource" and policy.degrade
+                      and hooks.rebuild is not None)
+        if hooks.retry <= 0 or hooks.restage is None \
+                or (budget <= 0 and not can_ladder):
+            final_failure(e, fail_step, attempts=1, cursor=cursor,
+                          fault_class=cls)
         replay = list(since_anchor)
         if sync_group is not None:
             replay.append((sync_group, cursor))
         fail_idx = next(i for i, (g, _) in enumerate(replay)
                         if g[0].step == fail_step)
         # Lifecycle records still owed: the doomed window's groups never
-        # retired (their records are emitted by the replay below, with
+        # retired (their records are emitted after the replay below, with
         # coarse serialized timestamps — the replay IS when they actually
         # completed); groups in `since_anchor` but NOT in the window
         # retired earlier and already own a record, so the replay must not
@@ -627,6 +892,34 @@ def _drive_stream(engine, job, config: Config, path, state,
         lost = {en.step_first: en.life for en in window}
         if sync_group is not None and sync_life is not None:
             lost[sync_group[0].step] = sync_life
+        # Quiesce the doomed window before replaying: the OTHER in-flight
+        # groups' programs may still be RUNNING — an injected token-wait
+        # fault abandons a healthy window — and a serial replay racing
+        # them contends for staging buffers and the backend's execution
+        # machinery (the interpret-mode pallas runtime is not safe under
+        # that concurrency; observed corrupting replay outputs).  Their
+        # tokens resolve promptly — the programs complete or fail, and a
+        # real hang is bounded by token_timeout_s — and any error they
+        # surface is subsumed by the replay below.
+        # A REAL TokenTimeout already spent the full timeout on the
+        # failed entry's token (and on a genuinely hung device would
+        # spend it again): its quiesce outcome is known, skip it.  An
+        # INJECTED token-wait fault raised before the wait ever ran —
+        # that entry's program may still be executing, so it must be
+        # quiesced like the rest.
+        already_waited = (entry is not None
+                          and isinstance(e, faults_mod.TokenTimeout)
+                          and not e.injected)
+        for doomed in window:
+            if already_waited and doomed is entry:
+                continue
+            try:
+                if policy.token_timeout_s:
+                    _wait_token_timed(doomed.token, policy.token_timeout_s)
+                else:
+                    _wait_token(doomed.token)
+            except Exception:
+                pass
         # Drop the doomed window, returning pool-issued staging buffers so
         # their ids never dangle in the pool's issued set (a freed buffer's
         # id can be reused by a reader-owned array, which give() would then
@@ -637,31 +930,67 @@ def _drive_stream(engine, job, config: Config, path, state,
             dropped = window.popleft()
             if hooks.stage_release is not None:
                 hooks.stage_release(dropped.staged)
-        retry_record(fail_step, 1, e)
-        state = hooks.restage(anchor)
-        used = [1]
-        for i, (group, group_cursor) in enumerate(replay):
-            replay_t0 = time.perf_counter()
-            state, replay_stats = serial_dispatch(
-                state, group, attempts_used=1 if i == fail_idx else 0,
-                used_out=used if i == fail_idx else None,
-                cursor=group_cursor)
+        # The windowed failure charges one attempt against its class —
+        # unless the class has no budget at all (the pure-ladder path,
+        # where the first resource fault goes straight to a degrade).
+        charged = 1 if budget > 0 else 0
+        if charged:
+            retry_record(fail_step, 1, e, fault_class=cls)
+            backoff(cls, 1, "dispatch")
+        used = [charged]
+        while True:  # degradation-ladder loop (one pass when no degrade)
+            try:
+                state = hooks.restage(anchor)
+                done: list = []
+                for i, (group, group_cursor) in enumerate(replay):
+                    replay_t0 = time.perf_counter()
+                    state, replay_stats = serial_dispatch(
+                        state, group,
+                        attempts_used=charged if i == fail_idx else 0,
+                        used_out=used if i == fail_idx else None,
+                        cursor=group_cursor, charged_class=cls)
+                    done.append((i, group, replay_t0,
+                                 time.perf_counter(), replay_stats))
+                break
+            except _DegradeSignal as ds:
+                nd = faults_mod.next_degrade(_config_summary(cur_config))
+                if nd is None:
+                    final_failure(ds.error, fail_step,
+                                  attempts=used[0] + 1, cursor=cursor,
+                                  fault_class="resource")
+                step_name, field, degraded = nd
+                was = _config_summary(cur_config)[field]
+                cur_config = _apply_degrade(cur_config, field, degraded)
+                pipe.setdefault("degrade_steps", []).append(step_name)
+                tel.registry.counter("executor.degrade_steps",
+                                     ladder_step=step_name).inc()
+                tel.event("degrade", ladder_step=step_name, field=field)
+                tel.ledger_write(
+                    "degrade", step=fail_step, ladder_step=step_name,
+                    field=field, **{"from": was, "to": degraded},
+                    fault_class="resource", error=repr(ds.error),
+                    write=hooks.write_gate())
+                log_event(logger, "degradation ladder step",
+                          ladder_step=step_name, field=field,
+                          to=degraded)
+                engine = hooks.rebuild(cur_config)
+        # Emit the owed lifecycle records only for the FINAL successful
+        # round: an aborted ladder round's groups were invalidated with
+        # their state, so emitting them would duplicate records (and
+        # double-fold data stats).  Coarse serialized stamps: the
+        # original enqueue was doomed with the window, so the replay's
+        # blocking re-dispatch is the group's real completion interval.
+        # Data stats fold only for groups that never retired: a group
+        # replayed from the anchor but retired earlier already
+        # contributed its counters once.
+        for i, group, t0, t1, replay_stats in done:
             life = lost.pop(group[0].step, None)
             if life is not None:
-                # Coarse serialized stamps: the original enqueue was doomed
-                # with the window, so the replay's blocking re-dispatch is
-                # the group's real completion interval (stage/dispatch/
-                # wait are not separable from out here — a timeline shows
-                # one serialized device slab, which is the truth).
-                # Data stats fold only for groups that never retired: a
-                # group replayed from the anchor but retired earlier
-                # already contributed its counters once.
-                done = time.perf_counter()
-                life = dict(life, staged_at=round(replay_t0, 6),
-                            dispatched_at=round(replay_t0, 6))
+                life = dict(life, staged_at=round(t0, 6),
+                            dispatched_at=round(t0, 6))
                 _group_record(tel, hooks.write_gate(), life,
-                              token_ready_at=done, retired_at=done,
-                              wait_s=done - replay_t0,
+                              token_ready_at=t1, retired_at=t1,
+                              wait_s=t1 - t0,
                               retries=used[0] if i == fail_idx else 0,
                               data=group_stats_data(replay_stats))
                 retired_groups += 1
@@ -672,14 +1001,31 @@ def _drive_stream(engine, job, config: Config, path, state,
             # was never enrolled: account it now that it landed.  It ran
             # serially, alone — depth 1, the serialized-window contract
             # (ledger consumers rely on inflight_depth >= 1, and the depth
-            # mean divides by dispatch_groups).
+            # mean divides by dispatch_groups).  Its charged attempts live
+            # on its GROUP record — the one place replay retries are
+            # charged on BOTH recovery paths (ISSUE 15 satellite: the
+            # async path's step record is written at dispatch, before any
+            # retry can exist, so the group record is the only consistent
+            # carrier).
             record_depth(1)
             account(sync_group, depth=1,
                     group_bytes=sync_life["group_bytes"] if sync_life
-                    else int(sum(int(b.lengths.sum()) for b in sync_group)),
-                    retries=used[0])
+                    else int(sum(int(b.lengths.sum()) for b in sync_group)))
         reanchor(state)
         return state
+
+    def token_wait(entry):
+        """The window's completion wait behind the token-wait seam
+        (ISSUE 15): the plan may inject here (the mid-window ASYNC fault
+        — it surfaces at the oldest group's retire, exactly like a real
+        late device error), and ``policy.token_timeout_s`` bounds the
+        wall-clock so a hung device reads as a typed TokenTimeout."""
+        if plan is not None:
+            cross("token-wait")
+        if policy.token_timeout_s:
+            _wait_token_timed(entry.token, policy.token_timeout_s)
+        else:
+            _wait_token(entry.token)
 
     def retire_oldest(state, phase="retire_wait"):
         """Block until the oldest in-flight group's program completed (its
@@ -690,9 +1036,9 @@ def _drive_stream(engine, job, config: Config, path, state,
         try:
             if phase is not None:
                 with obs.span(phase, timer):
-                    _wait_token(entry.token)
+                    token_wait(entry)
             else:
-                _wait_token(entry.token)
+                token_wait(entry)
         except Exception as e:
             return recover(state, e, entry=entry)
         token_ready_at = time.perf_counter()
@@ -732,23 +1078,44 @@ def _drive_stream(engine, job, config: Config, path, state,
         pipe["depth_max"] = max(pipe["depth_max"], depth)
         tel.registry.observe("executor.inflight_depth", depth)
 
-    def account(group, depth, group_bytes, retries=0):
+    def account(group, depth, group_bytes):
         """Advance the cursor, bases, and telemetry for one dispatched
         group: the ledger step record is written at dispatch, in step
         order — one per dispatched group, completion observed later.
         ``group_bytes`` comes from the caller's lifecycle record: the
-        batch lengths are summed exactly once per group."""
+        batch lengths are summed exactly once per group.  Replay retries
+        are NOT stamped here (ISSUE 15 satellite): the async recovery
+        path's step record is written at dispatch, before any retry can
+        exist, so charging them here on the sync path only made the two
+        paths disagree — the group record is the one consistent carrier.
+
+        The ledger-append seam crosses here (ISSUE 15): an injected
+        append fault is recorded and ABSORBED — observing must never take
+        down the observed run, so the policy outcome for the telemetry
+        plane is always degrade-to-unobserved, not death."""
         nonlocal bytes_done, step_index, last_file_dispatched
         last_file_dispatched = group[-1].file_index
         for b in group:
             bases_list.append(b.base_offsets)
         bytes_done += group_bytes
         step_index = group[-1].step + 1
-        tel.step_record(step_first=group[0].step, step_last=group[-1].step,
-                        group_bytes=group_bytes,
-                        cursor_bytes=bytes_done, timer=timer,
-                        retries=retries, inflight_depth=depth,
-                        write=hooks.write_gate())
+        skip_record = False
+        if plan is not None:
+            try:
+                cross("ledger-append")
+            except faults_mod.FaultError as fe:
+                if fe.fault_class == "preemption":
+                    raise
+                skip_record = True
+                log_event(logger, "ledger append fault absorbed",
+                          error=repr(fe))
+        if not skip_record:
+            tel.step_record(step_first=group[0].step,
+                            step_last=group[-1].step,
+                            group_bytes=group_bytes,
+                            cursor_bytes=bytes_done, timer=timer,
+                            inflight_depth=depth,
+                            write=hooks.write_gate())
         heartbeat()
         if progress_every and step_index % progress_every < len(group):
             log_event(logger, "progress", step=step_index, bytes=bytes_done)
@@ -772,6 +1139,49 @@ def _drive_stream(engine, job, config: Config, path, state,
         depth = len(window)
         record_depth(depth)
         account(group, depth, life["group_bytes"])
+
+    def stack_bases():
+        return np.stack(bases_list) if bases_list \
+            else np.zeros((0, engine.n_devices), np.int64)
+
+    def save_snapshot(state_host):
+        """The checkpoint write behind the checkpoint-save seam +
+        policy (ISSUE 15): injected AND real save failures retry on the
+        per-class budget (the save is idempotent — atomic tmp+rename),
+        and an exhausted budget DEGRADES — fault recorded, loud log, the
+        run continues without this snapshot — instead of killing a
+        healthy stream.  Durability is reduced; results are not.
+        Returns True when the snapshot landed."""
+        attempt = 0
+        while True:
+            try:
+                if plan is not None:
+                    cross("checkpoint-save")
+                if hooks.write_gate():
+                    ckpt_mod.save(checkpoint_path, state_host, step_index,
+                                  bytes_done, stack_bases(),
+                                  fingerprint=fingerprint,
+                                  file_index=last_file_dispatched)
+                return True
+            except faults_mod.PreemptionFault:
+                raise
+            except Exception as ce:
+                ccls = faults_mod.classify(ce)
+                if not (isinstance(ce, faults_mod.FaultError)
+                        and ce.injected):
+                    _record_fault(tel, hooks.write_gate(), ce,
+                                  seam="checkpoint-save", injected=False,
+                                  step=step_index)
+                if attempt >= policy.budget(ccls):
+                    log_event(logger,
+                              "checkpoint save failed; continuing "
+                              "without this snapshot",
+                              error=repr(ce), fault_class=ccls)
+                    return False
+                attempt += 1
+                retry_record(step_index, attempt, ce, fault_class=ccls,
+                             seam="checkpoint-save")
+                backoff(ccls, attempt, "checkpoint-save")
 
     def flush(state, group):
         """Dispatch a group of consecutive batches (one superstep, split at
@@ -826,6 +1236,12 @@ def _drive_stream(engine, job, config: Config, path, state,
             life["dispatched_at"] = round(time.perf_counter(), 6)
             enroll(out, stats, staged, group, cursor_before, life)
             state = out
+        if plan is not None:
+            # Whole-process kill (ISSUE 15, multi-host chaos): crossed
+            # once per dispatched group, AFTER the group is enrolled and
+            # accounted — the hard-kill lands between groups, exactly
+            # where a platform reclaim would.
+            cross("process-kill")
         if (checkpoint_every and checkpoint_path
                 and step_index // checkpoint_every > last_ckpt):
             # Checkpoint boundary: retire everything (a failure discovered
@@ -843,25 +1259,22 @@ def _drive_stream(engine, job, config: Config, path, state,
             with obs.span("checkpoint", timer):
                 # retry mode just re-anchored on this very state: reuse the
                 # fetch instead of paying a second device->host round.
+                # file_index makes the snapshot boundary-aware: resuming
+                # a checkpoint that ends a corpus member must still fire
+                # the job's on_input_boundary hook on the next member's
+                # first batch (the carry reset happens AFTER this save
+                # in the stream loop).
                 state_host = anchor if hooks.retry > 0 \
                     else hooks.snapshot(state)
-                if hooks.write_gate():
-                    # file_index makes the snapshot boundary-aware: resuming
-                    # a checkpoint that ends a corpus member must still fire
-                    # the job's on_input_boundary hook on the next member's
-                    # first batch (the carry reset happens AFTER this save
-                    # in the stream loop).
-                    ckpt_mod.save(checkpoint_path, state_host, step_index,
-                                  bytes_done, np.stack(bases_list),
-                                  fingerprint=fingerprint,
-                                  file_index=last_file_dispatched)
+                saved = save_snapshot(state_host)
             tel.event("checkpoint", step=step_index, cursor_bytes=bytes_done)
-            tel.ledger_write(
-                "checkpoint", step=step_index, cursor_bytes=bytes_done,
-                save_s=round(timer["checkpoint"] - ck_before, 6),
-                path=checkpoint_path, write=hooks.write_gate())
-            log_event(logger, "checkpoint", step=step_index,
-                      path=checkpoint_path, writer=hooks.write_gate())
+            if saved:
+                tel.ledger_write(
+                    "checkpoint", step=step_index, cursor_bytes=bytes_done,
+                    save_s=round(timer["checkpoint"] - ck_before, 6),
+                    path=checkpoint_path, write=hooks.write_gate())
+                log_event(logger, "checkpoint", step=step_index,
+                          path=checkpoint_path, writer=hooks.write_gate())
         return state
 
     # Jobs with cross-row sequential state (grep's line carry) reset it at
@@ -878,6 +1291,34 @@ def _drive_stream(engine, job, config: Config, path, state,
     # with the window (Config.prefetch_depth: deep enough to feed a full
     # window).  The manual iterator lets read_wait be timed: time spent
     # HERE is the reader failing to keep ahead of the device.
+    def read_guarded():
+        """One reader read behind the reader-read seam (ISSUE 15): the
+        injected fault fires BEFORE the underlying ``next``, so retrying
+        it on the policy budget is always safe.  A REAL reader error is
+        recorded as a typed fault and propagates — the prefetch iterator
+        is dead after raising, and re-nexting a dead generator would read
+        as a silent end-of-stream (a truncation, the one unforgivable
+        outcome)."""
+        attempt = 0
+        while True:
+            try:
+                cross("reader-read")
+                return next(it, None)
+            except faults_mod.FaultError as fe:
+                if not fe.injected or fe.fault_class == "preemption":
+                    raise
+                if attempt >= policy.budget(fe.fault_class):
+                    raise
+                attempt += 1
+                retry_record(step_index, attempt, fe,
+                             fault_class=fe.fault_class, seam="reader-read")
+                backoff(fe.fault_class, attempt, "reader-read")
+            except Exception as re_:
+                _record_fault(tel, hooks.write_gate(), re_,
+                              seam="reader-read", injected=False,
+                              step=step_index)
+                raise
+
     it = iter(reader_mod.prefetch(
         reader_mod.iter_batches_multi(path, engine.n_devices,
                                       config.chunk_bytes,
@@ -885,49 +1326,103 @@ def _drive_stream(engine, job, config: Config, path, state,
                                       start_step=start_step,
                                       end_offset=end_offset),
         depth=config.resolved_prefetch_depth))
-    while True:
-        with obs.span("read_wait", timer):
-            batch = next(it, None)
-        if batch is None:
-            break
-        read_t[batch.step] = time.perf_counter()
-        if hooks.stage_arrival is not None:
-            with obs.span("stage", timer):
-                batch = hooks.stage_arrival(batch)
-        if (boundary_hook is not None and last_file is not None
-                and batch.file_index != last_file):
-            if pending:
+    try:
+        while True:
+            with obs.span("read_wait", timer):
+                batch = next(it, None) if plan is None else read_guarded()
+            if batch is None:
+                break
+            read_t[batch.step] = time.perf_counter()
+            if hooks.stage_arrival is not None:
+                with obs.span("stage", timer):
+                    batch = hooks.stage_arrival(batch)
+            if (boundary_hook is not None and last_file is not None
+                    and batch.file_index != last_file):
+                if pending:
+                    state = flush(state, pending)
+                    pending = []
+                # Retire at the file boundary: a failure in the old file's
+                # groups is attributed there, and the boundary hook's state
+                # edit invalidates the replay anchor (re-taken lazily).
+                state = drain_window(state, do_reanchor=False)
+                pipe["boundary_drains"] += 1
+                state = boundary_hook(state)
+                anchor = None
+                del since_anchor[:]
+            last_file = batch.file_index
+            pending.append(batch)
+            if len(pending) == k:
                 state = flush(state, pending)
                 pending = []
-            # Retire at the file boundary: a failure in the old file's
-            # groups is attributed there, and the boundary hook's state
-            # edit invalidates the replay anchor (re-taken lazily).
-            state = drain_window(state, do_reanchor=False)
-            pipe["boundary_drains"] += 1
-            state = boundary_hook(state)
-            anchor = None
-            del since_anchor[:]
-        last_file = batch.file_index
-        pending.append(batch)
-        if len(pending) == k:
-            state = flush(state, pending)
-            pending = []
-    for batch in pending:  # remainder: single steps (no extra jit cache keys)
-        state = flush(state, [batch])
-    # End-of-stream tail decomposition (the old opaque `drain`): h2d_tail =
-    # the last group's staged input still in transfer when the reader ran
-    # dry; compute_tail = device work still queued behind it.  Spanned even
-    # when empty so the phase keys always exist for reports.
-    with obs.span("h2d_tail", timer):
-        if window:
-            jax.block_until_ready(window[-1].staged)
-            # The one per-group H2D completion the loop DOES observe (the
-            # reader ran dry, so this wait serializes nothing): the last
-            # group's record carries it, giving the timeline a measured
-            # h2d lane interval instead of pure inference.
-            window[-1].life["h2d_done_at"] = round(time.perf_counter(), 6)
-    with obs.span("compute_tail", timer):
-        state = drain_window(state, phase=None, do_reanchor=False)
+        for batch in pending:  # remainder: single steps (no extra jit keys)
+            state = flush(state, [batch])
+        # End-of-stream tail decomposition (the old opaque `drain`):
+        # h2d_tail = the last group's staged input still in transfer when
+        # the reader ran dry; compute_tail = device work still queued
+        # behind it.  Spanned even when empty so the phase keys always
+        # exist for reports.
+        with obs.span("h2d_tail", timer):
+            if window:
+                jax.block_until_ready(window[-1].staged)
+                # The one per-group H2D completion the loop DOES observe
+                # (the reader ran dry, so this wait serializes nothing):
+                # the last group's record carries it, giving the timeline
+                # a measured h2d lane interval instead of pure inference.
+                window[-1].life["h2d_done_at"] = \
+                    round(time.perf_counter(), 6)
+        with obs.span("compute_tail", timer):
+            state = drain_window(state, phase=None, do_reanchor=False)
+    except BaseException as pe:
+        # Preemption (ISSUE 15): drain the in-flight window (the groups
+        # are healthy — the signal is not a device error; their bytes are
+        # already accounted), snapshot if a checkpoint is configured, and
+        # exit CLEANLY with the resumable cursor.  Caught by CLASS, not
+        # type: recover() re-raises REAL preemption-shaped exceptions
+        # (SIGTERM/maintenance-event markers) unwrapped, and
+        # KeyboardInterrupt — classified preemption — is a BaseException
+        # that never even routes through recover().  Anything not
+        # preemption-classed re-raises untouched.  The plan is disarmed
+        # first so no second injected fault can interrupt the orderly
+        # shutdown (a real platform sends one SIGTERM, not a stream).
+        if faults_mod.classify(pe) != "preemption":
+            raise
+        plan = None
+        state = drain_window(state, do_reanchor=False)
+        checkpointed = False
+        if checkpoint_path:
+            ck_before = timer["checkpoint"]
+            with obs.span("checkpoint", timer):
+                # The state fetch rides the same absorb-and-continue
+                # discipline as the save: under a real preemption the
+                # device may already be going away, and an unfetchable
+                # state degrades to an uncheckpointed (still orderly)
+                # exit, never a crash inside the drain handler.
+                try:
+                    state_host = hooks.snapshot(state)
+                except Exception as se:
+                    _record_fault(tel, hooks.write_gate(), se,
+                                  seam="checkpoint-save", injected=False,
+                                  step=step_index)
+                    log_event(logger,
+                              "preemption snapshot fetch failed; "
+                              "exiting without checkpoint",
+                              step=step_index, error=str(se))
+                    state_host = None
+                if state_host is not None:
+                    checkpointed = save_snapshot(state_host)
+            if checkpointed:
+                tel.ledger_write(
+                    "checkpoint", step=step_index, cursor_bytes=bytes_done,
+                    save_s=round(timer["checkpoint"] - ck_before, 6),
+                    path=checkpoint_path, preempt=True,
+                    write=hooks.write_gate())
+        log_event(logger, "preempted; drained and exiting cleanly",
+                  step=step_index, cursor=bytes_done,
+                  checkpointed=checkpointed)
+        raise faults_mod.Preempted(
+            step=step_index, cursor_bytes=bytes_done,
+            checkpoint_path=checkpoint_path,
+            checkpointed=checkpointed) from pe
     n_groups = pipe["dispatch_groups"]
     pipe["depth_mean"] = round(pipe.pop("depth_sum") / n_groups, 2) \
         if n_groups else 0.0
@@ -1021,6 +1516,15 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         raise ValueError(f"retry must be >= 0, got {retry}")
     logger = logger or get_logger()
     tel = obs.maybe(telemetry)
+    # Unified failure policy + fault plan (ISSUE 15): the legacy `retry`
+    # counter resolves into per-class budgets (None policy = exactly the
+    # old semantics), and the policy's dispatch budget is what arms the
+    # snapshot/replay machinery below — an explicit policy with budgets
+    # enables replay without the caller touching `retry`.
+    plan = faults_mod.FaultPlan.resolve(config.fault_plan)
+    policy = faults_mod.FailurePolicy.resolve(config.failure_policy,
+                                              retry=retry)
+    retry = policy.dispatch_budget
     mesh = mesh if mesh is not None else data_mesh()
     # Shard over EVERY mesh axis: a 2-D ('replica','data') mesh contributes
     # all its devices to the data-parallel stream (the Engine linearizes the
@@ -1051,18 +1555,26 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         pallas_max_token=config.pallas_max_token, byte_range=byte_range,
         job_identity=job.identity()) \
         if checkpoint_path else None
+    ck_fallback = None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         # An abstract state (shapes/dtypes only, no device allocation) is
         # the structural template: any drift in job kind, capacities,
         # sketch precision, or device count surfaces as CheckpointMismatch
-        # (shapes are ground truth).
+        # (shapes are ground truth).  A torn/corrupt snapshot falls back
+        # to the previous good one (ISSUE 15 satellite; the fallback is
+        # noted in the ledger after run_start) instead of crashing.
         template = jax.eval_shape(engine.init_states)
-        state_np, start_step, start_offset, bases_arr, resumed_file = \
-            ckpt_mod.load(checkpoint_path, template=template,
-                          expect_fingerprint=fingerprint)
-        state = jax.device_put(state_np, engine._sharded)
+        (state_np, start_step, start_offset, bases_arr, resumed_file), \
+            ck_fallback = ckpt_mod.load_resilient(
+                checkpoint_path, template=template,
+                expect_fingerprint=fingerprint)
+        state = _owned_state(jax.device_put(state_np, engine._sharded))
         bases_list = list(bases_arr)
-        log_event(logger, "resumed from checkpoint", step=start_step, offset=start_offset)
+        log_event(logger, "resumed from checkpoint", step=start_step,
+                  offset=start_offset)
+        if ck_fallback is not None:
+            log_event(logger, "corrupt checkpoint; resumed from previous "
+                      "good snapshot", **ck_fallback)
     else:
         state = engine.init_states()
         resumed_file = None
@@ -1089,17 +1601,38 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         np.stack([b.data for b in g], axis=1, out=buf)
         return buf
 
+    def rebuild(new_config: Config):
+        """Degradation-ladder engine rebuild (ISSUE 15): same mesh, same
+        state SHAPES (the ladder only moves kernel-choice knobs — each
+        bit-identity-tested), cheaper programs.  The job is rebound so
+        every map call site reads the degraded knobs; the anchor snapshot
+        restages into the new engine unchanged."""
+        nonlocal job, engine
+        job = _job_with_config(job, new_config)
+        engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
+                        merge_strategy=merge_strategy, data_stats=data_stats)
+        return engine
+
     hooks = _StreamHooks(
         stage_single=lambda b: b.data,
         stage_group=stage_group_np if retry > 0 else
         (lambda g: jnp.stack([b.data for b in g], axis=1)),
-        snapshot=lambda s: jax.tree.map(np.asarray, s),
-        restage=lambda s_np: jax.device_put(s_np, engine._sharded),
+        # An honest COPY, not np.asarray: on the CPU backend np.asarray
+        # of a jax array is a zero-copy VIEW of the live buffer, and the
+        # state it views is donated into the next dispatch — a snapshot
+        # that can be overwritten is not a known-good anchor.
+        snapshot=lambda s: jax.tree.map(lambda x: np.array(x, copy=True),
+                                        s),
+        # _owned_state: the restaged tree is donated into the next step —
+        # a raw device_put result is not donation-safe (see _owned_state).
+        restage=lambda s_np: _owned_state(
+            jax.device_put(s_np, engine._sharded)),
         write_gate=lambda: True,
         retry=retry,
         stage_release=pool.give if retry > 0 else None,
         stage_arrival=None if retry > 0 else (lambda b: dataclasses.replace(
-            b, data=jax.device_put(b.data, engine.sharding))))
+            b, data=jax.device_put(b.data, engine.sharding))),
+        rebuild=rebuild)
     if jax.process_count() > 1:
         # Per-host-driven multi-host (mode a): each host owns its whole
         # ledger file already, so no second shard file — but the records
@@ -1111,16 +1644,28 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                         local_devices=len(jax.local_devices()),
                         clock=dist.run_epoch(), shard=False)
     tel.registry.counter("executor.runs", driver="run_job").inc()
+    # run_start stamps the fault plan's canonical spec (ISSUE 15, ledger
+    # v9) so a chaotic ledger names its own chaos; absent when injection
+    # is off, keeping fault-free records byte-identical to v8 shapes.
+    chaos_stamp = {"fault_plan": plan.spec} if plan is not None else {}
     tel.ledger_write("run_start", driver="run_job", job=job.identity(),
                      devices=n_dev, chunk_bytes=config.chunk_bytes,
                      superstep=config.superstep,
                      backend=config.resolved_backend(),
                      map_impl=config.map_impl,
                      combiner=config.resolved_combiner,
-                     **_geometry_stamp(config),
+                     **_geometry_stamp(config), **chaos_stamp,
                      merge_strategy=merge_strategy, input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      retry=retry)
+    if ck_fallback is not None:
+        # The corrupt-checkpoint fallback's ledger note (ISSUE 15
+        # satellite): a real checkpoint-load fault, observed and healed.
+        tel.ledger_write("fault", seam="checkpoint-load",
+                         fault_class="transient", injected=False,
+                         error=ck_fallback["error"],
+                         fallback=ck_fallback["loaded"],
+                         corrupt=ck_fallback["corrupt"])
     timer.start("stream")
     try:
         state, bytes_done, _, pipe = _drive_stream(
@@ -1130,7 +1675,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             fingerprint=fingerprint, resumed_file=resumed_file,
             logger=logger, progress_every=progress_every, timer=timer,
-            telemetry=tel, data_agg=data_agg)
+            telemetry=tel, data_agg=data_agg, plan=plan, policy=policy)
         # Residual drain: the stream loop already retired every in-flight
         # group (h2d_tail/compute_tail decompose what this phase used to
         # lump together); this keeps the stream/reduce boundary honest.
@@ -1140,7 +1685,8 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
 
         with obs.span("reduce", timer):
             fin_t0 = time.perf_counter()
-            value = engine.finish(state)
+            value = _collective_finish(engine, state, plan, policy, tel,
+                                       True, logger)
             value = jax.tree.map(np.asarray, value)  # block + fetch the result
             # One `collective` record per run (ISSUE 13): the observed
             # finish interval + merge strategy — the fleet timeline's
@@ -1149,6 +1695,11 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                              strategy=merge_strategy,
                              started_at=round(fin_t0, 6),
                              ended_at=round(time.perf_counter(), 6))
+    except faults_mod.Preempted:
+        # Orderly preemption shutdown (ISSUE 15), not a failure: the
+        # stream drained, the snapshot (if configured) landed, and the
+        # exception carries the resumable cursor — no flight dump.
+        raise
     except Exception as e:
         # Dispatch failures already dumped inside _drive_stream (with step
         # context); this catches everything else on the streaming path —
@@ -1231,6 +1782,16 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
 
     logger = logger or get_logger()
     tel = obs.maybe(telemetry)
+    # Fault plan + failure policy (ISSUE 15): the global driver gets the
+    # full seam set (incl. process-kill — the multi-host chaos scenario)
+    # but NO window replay (restage=None below: a failed collective
+    # leaves peers blocked mid-program, checkpoint/resume is the recovery
+    # path) and no degradation ladder (rebuild=None: every process would
+    # have to step in lockstep).  The policy still drives reader/
+    # checkpoint-save/collective-finish retries and the token timeout.
+    plan = faults_mod.FaultPlan.resolve(config.fault_plan)
+    policy = faults_mod.FailurePolicy.resolve(config.failure_policy,
+                                              retry=0)
     mesh = mesh if mesh is not None else dist.global_data_mesh()
     axes = tuple(mesh.axis_names)
     n_dev = mesh.size
@@ -1280,15 +1841,23 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             buf[:, j] = b.data[mine]
         return stage(buf)
 
+    ck_fallback = None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         template = jax.eval_shape(engine.init_states_global)
-        state_np, start_step, start_offset, bases_arr, resumed_file = \
-            ckpt_mod.load(checkpoint_path, template=template,
-                          expect_fingerprint=fingerprint)
-        state = jax.tree.map(lambda x: stage(np.asarray(x)[mine]), state_np)
+        (state_np, start_step, start_offset, bases_arr, resumed_file), \
+            ck_fallback = ckpt_mod.load_resilient(
+                checkpoint_path, template=template,
+                expect_fingerprint=fingerprint)
+        # _owned_state: the resumed tree is donated into the first global
+        # step — a raw transfer-created buffer is not donation-safe.
+        state = _owned_state(
+            jax.tree.map(lambda x: stage(np.asarray(x)[mine]), state_np))
         bases_list = list(bases_arr)
         log_event(logger, "resumed from checkpoint (global)",
                   step=start_step, offset=start_offset)
+        if ck_fallback is not None:
+            log_event(logger, "corrupt checkpoint; resumed from previous "
+                      "good snapshot", **ck_fallback)
     else:
         state = engine.init_states_global()
         resumed_file = None
@@ -1318,6 +1887,7 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     tel.registry.counter("executor.runs", driver="run_job_global").inc()
     # The main ledger rides the same gate as checkpoints: one file,
     # written by the coordinator; the per-host shard gets every record.
+    chaos_stamp = {"fault_plan": plan.spec} if plan is not None else {}
     tel.ledger_write("run_start", driver="run_job_global",
                      job=job.identity(), devices=n_dev,
                      chunk_bytes=config.chunk_bytes,
@@ -1325,11 +1895,18 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      backend=config.resolved_backend(),
                      map_impl=config.map_impl,
                      combiner=config.resolved_combiner,
-                     **_geometry_stamp(config),
+                     **_geometry_stamp(config), **chaos_stamp,
                      merge_strategy=merge_strategy,
                      input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      write=dist.is_coordinator())
+    if ck_fallback is not None:
+        tel.ledger_write("fault", seam="checkpoint-load",
+                         fault_class="transient", injected=False,
+                         error=ck_fallback["error"],
+                         fallback=ck_fallback["loaded"],
+                         corrupt=ck_fallback["corrupt"],
+                         write=dist.is_coordinator())
     timer.start("stream")
     try:
         state, bytes_done, _, pipe = _drive_stream(
@@ -1339,14 +1916,18 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             fingerprint=fingerprint, resumed_file=resumed_file,
             logger=logger, progress_every=progress_every, timer=timer,
-            telemetry=tel)
+            telemetry=tel, plan=plan, policy=policy)
         with obs.span("drain", timer):
             jax.block_until_ready(state)
         timer.stop("stream")
 
         with obs.span("reduce", timer):
             fin_t0 = time.perf_counter()
-            value = engine.finish(state)  # replicated: addressable everywhere
+            # Replicated finish: addressable everywhere.  The collective-
+            # finish seam + injected-fault retry budget wrap it (ISSUE
+            # 15); real collective failures classify, record, propagate.
+            value = _collective_finish(engine, state, plan, policy, tel,
+                                       dist.is_coordinator(), logger)
             value = jax.tree.map(np.asarray, value)
             # Every host times the SAME collective finish from its own
             # side (ISSUE 13): the fleet `collective` lane + the
@@ -1356,6 +1937,10 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                              started_at=round(fin_t0, 6),
                              ended_at=round(time.perf_counter(), 6),
                              write=dist.is_coordinator())
+    except faults_mod.Preempted:
+        # Orderly preemption shutdown (ISSUE 15): resumable, not a
+        # failure — no flight dump.
+        raise
     except Exception as e:
         # Each process dumps to its OWN (host-suffixed) flight path —
         # no shared-file race, and the failing host's forensics survive

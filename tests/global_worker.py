@@ -10,13 +10,20 @@ checkpoint/resume recovery path a second launch completes.
 
 Usage: python global_worker.py <process_id> <n_processes> <port> \
     <corpus_path> <chunk_bytes> <devices_per_process> <ckpt_path> \
-    <crash_at_step> [ledger_path]
+    <crash_at_step> [ledger_path] [fault_plan]
 
 ``ledger_path`` (optional, ISSUE 13): attach full telemetry at that
 shared path — every process then writes its own ``<ledger>.h<p>.jsonl``
 shard (with a shared run_id, so fleet merges pair runs explicitly), the
 coordinator the main file, and a crash dumps each host's flight recorder
 to its host-suffixed path.
+
+``fault_plan`` (optional, ISSUE 15): a ``Config.fault_plan`` spec fired
+through the executor's real injection seams — ``at=process-kill:N:...``
+is the multi-host hard-kill chaos scenario (``os._exit(113)`` between
+dispatched groups on every process at the same deterministic crossing,
+exactly like a synchronized platform reclaim; the relaunch resumes from
+the coordinator's checkpoint).
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ def main() -> int:
     chunk_bytes, dev_per_proc = int(sys.argv[5]), int(sys.argv[6])
     ckpt_path, crash_at = sys.argv[7], int(sys.argv[8])
     ledger_path = sys.argv[9] if len(sys.argv) > 9 else None
+    fault_plan = sys.argv[10] if len(sys.argv) > 10 else None
 
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={dev_per_proc}")
@@ -63,7 +71,8 @@ def main() -> int:
 
         mr.Engine.step = crashing_step
 
-    cfg = Config(chunk_bytes=chunk_bytes, table_capacity=1 << 10)
+    cfg = Config(chunk_bytes=chunk_bytes, table_capacity=1 << 10,
+                 fault_plan=fault_plan or None)
     telemetry = None
     if ledger_path:
         from mapreduce_tpu.obs import Telemetry

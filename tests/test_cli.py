@@ -316,7 +316,7 @@ def test_batch_ledger_without_stream(tmp_path, capsys):
     assert [x["kind"] for x in recs] == ["run_start", "data", "run_end"]
     start, data, end = recs
     assert start["driver"] == "single_buffer" and start["job"] == "wordcount"
-    assert start["ledger_version"] == 8
+    assert start["ledger_version"] == 9
     assert data["tokens"] == 5 and data["table_valid"] == 3
     assert data["top_count"] == 3 and data["dropped_tokens"] == 0
     assert end["words"] == 5 and end["elapsed_s"] > 0
@@ -335,3 +335,52 @@ def test_batch_ledger_without_stream(tmp_path, capsys):
     grecs = obs_report.read_ledger(str(gled))
     assert [x["kind"] for x in grecs] == ["run_start", "run_end"]
     assert grecs[0]["job"] == "grep" and grecs[1]["words"] == 2
+
+
+def test_env_fault_plan_skipped_off_stream(tmp_path, capsys, monkeypatch):
+    """Exporting MAPREDUCE_FAULT_PLAN to chaos-test a streamed service
+    must not hard-error unrelated batch-mode invocations: the env
+    default binds only to --stream runs; off-stream it warns and runs
+    clean.  An EXPLICIT --fault-plan without --stream still errors."""
+    from mapreduce_tpu import cli
+
+    f = tmp_path / "in.txt"
+    f.write_bytes(b"alpha beta alpha\n")
+    monkeypatch.setenv("MAPREDUCE_FAULT_PLAN", "seed=1,rate=0.5")
+    rc = cli.main([str(f), "--format", "json", "--no-echo"])
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    assert "fault injection skipped" in out.err
+    with pytest.raises(SystemExit) as ei:
+        cli.main([str(f), "--fault-plan", "seed=1", "--format", "json",
+                  "--no-echo"])
+    assert ei.value.code == 2
+    capsys.readouterr()
+
+
+def test_preemption_exits_resumable_75(tmp_path, capsys):
+    """ISSUE 15: a preemption-classed fault is an ORDERLY shutdown on the
+    CLI surface — drain, checkpoint, one-line `preempted:` stderr, exit
+    75 (EX_TEMPFAIL: relaunch the same command to resume) — never a
+    traceback.  The relaunch-resumes-exactly half lives at the executor
+    level (test_faults.test_preemption_drains_checkpoints_and_resumes);
+    paying a second streamed run here would only re-prove it.
+    In-process (no subprocess jax startup): the tier-1 budget rule."""
+    from mapreduce_tpu import cli
+    from mapreduce_tpu.runtime import checkpoint as ckpt_mod
+
+    corpus = b"alpha beta alpha gamma beta alpha delta\n" * 300
+    f = tmp_path / "in.txt"
+    f.write_bytes(corpus)
+    ck = tmp_path / "ck.npz"
+    rc = cli.main([str(f), "--stream", "--chunk-bytes", "512",
+                   "--retry", "1", "--checkpoint", str(ck),
+                   "--checkpoint-every", "2", "--format", "json",
+                   "--no-echo", "--fault-plan",
+                   "at=dispatch:1:preemption"])
+    out = capsys.readouterr()
+    assert rc == 75, (rc, out.err)
+    assert "preempted:" in out.err and "Traceback" not in out.err
+    assert ck.exists(), "the drain must leave a resumable snapshot"
+    assert ckpt_mod.verify(str(ck)) is True, \
+        "the preemption snapshot must carry a passing integrity sidecar"

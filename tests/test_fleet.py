@@ -371,7 +371,7 @@ def test_telemetry_attach_host_opens_shard_and_stamps(tmp_path):
     assert [r["kind"] for r in shard] == ["run_start", "group", "checkpoint"]
     assert all(r["host"] == 1 for r in shard)
     start = shard[0]
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 8
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
     assert start["processes"] == 2 and start["local_devices"] == 2
     assert start["clock"] == {"wall": 10.0, "mono": 3.0}
     assert "clock" not in shard[1], "topology rides run_start only"

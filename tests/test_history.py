@@ -54,7 +54,8 @@ def test_obswatch_selftest():
 def test_ingest_across_versions(tmp_path):
     """One warehouse over the whole fixture zoo: v2-v5 mini runs, the v6
     geometry run, the v7 fleet shards (fleet verdict attached), the v8
-    in-flight run, and the v99 future ledger — every version ingests,
+    in-flight run, the v9 chaotic run (fault/degrade records
+    skip-or-consume), and the v99 future ledger — every version ingests,
     none errors (the forward-compat contract)."""
     idx = history.ingest([str(FIXTURES / "mini_ledger.jsonl"),
                           str(FIXTURES / "mini_ledger_b.jsonl"),
@@ -62,7 +63,8 @@ def test_ingest_across_versions(tmp_path):
                           str(FIXTURES / "future_ledger.jsonl")],
                          str(tmp_path))
     rows = {r["run_id"]: r for r in idx["runs"].values()}
-    assert len(idx["runs"]) == 12  # 9 mini + 1 b + 1 fleet + 1 future
+    assert len(idx["runs"]) == 13  # 10 mini + 1 b + 1 fleet + 1 future
+    assert rows["fixture11"]["completed"] is True  # degraded, alive (v9)
     assert rows["fixture01"]["completed"] is True
     assert rows["fixture05"]["data_verdict"] == "spill-bound"
     assert rows["fixture06"]["geometry"] == "tall512"
@@ -336,7 +338,7 @@ def test_progress_records_on_real_run(streamed_ledger):
     from mapreduce_tpu import obs
 
     recs = list(obs.read_ledger(streamed_ledger["ledger"]))
-    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 8
+    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 9
     rid = streamed_ledger["run_ids"][0]
     prog = [r for r in recs
             if r["kind"] == "progress" and r["run_id"] == rid]

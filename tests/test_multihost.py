@@ -202,10 +202,68 @@ def test_run_job_global_multiprocess_with_crash_resume(tmp_path):
     assert got["processes"] == 2 and got["devices"] == 4
 
 
+@pytest.mark.slow
+def test_run_job_global_host_kill_fault_resumes(tmp_path):
+    """ISSUE 15 chaos matrix: the process-kill seam on the REAL
+    2-process gloo harness.  A fault plan hard-kills every process
+    (``os._exit(113)``) at the same deterministic crossing — a
+    synchronized platform reclaim, fired through the executor's own
+    injection seam rather than a monkeypatched step — after the
+    coordinator has checkpointed; each process's ledger shard records
+    the `fault` before dying; a plan-free relaunch resumes from the
+    checkpoint to the exact oracle counts."""
+    import json
+    import os
+
+    corpus = (b"Hello World EveryOne\nWorld Good News\n"
+              b"Good Morning Hello\n" * 40)
+    path = tmp_path / "gk.txt"
+    path.write_bytes(corpus)
+    ckpt = str(tmp_path / "gk.ck.npz")
+    ledger = str(tmp_path / "gk.jsonl")
+
+    # Round 1: the plan kills both processes at process-kill crossing 2
+    # (the third dispatched group) — checkpoint_every=1 guarantees a
+    # snapshot exists by then.
+    procs, outs = _launch_global_workers(
+        path, ckpt, crash_at=-1, ledger=ledger,
+        fault_plan="at=process-kill:2:permanent")
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 113, \
+            f"hard-kill missing:\nrc={p.returncode}\n{err[-2000:]}"
+    assert os.path.exists(ckpt), "no checkpoint written before the kill"
+    # Every process's shard recorded the typed fault before os._exit —
+    # the flushed-ledger contract is what makes a kill diagnosable.
+    from mapreduce_tpu import obs
+
+    for proc_index in (0, 1):
+        shard = f"{ledger}.h{proc_index}.jsonl"
+        assert os.path.exists(shard), shard
+        faults_recs = [r for r in obs.read_ledger(shard)
+                       if r.get("kind") == "fault"]
+        assert any(f.get("seam") == "process-kill" and f.get("injected")
+                   for f in faults_recs), (proc_index, faults_recs)
+
+    # Round 2: plan-free relaunch resumes and finishes exactly.
+    procs, outs = _launch_global_workers(path, ckpt, crash_at=-1)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"resume failed:\n{err[-2000:]}"
+    json_lines = [ln for out, _ in outs for ln in out.splitlines()
+                  if ln.startswith("{")]
+    assert len(json_lines) == 1, json_lines
+    got = json.loads(json_lines[0])
+    expected = oracle.word_counts(corpus)
+    assert got["total"] == oracle.total_count(corpus)
+    assert got["distinct"] == len(expected)
+    assert got["counts"] == sorted(expected.values())
+
+
 def _launch_global_workers(path, ckpt, crash_at, ledger=None,
-                           chunk_bytes=256):
+                           chunk_bytes=256, fault_plan=None):
     """Spawn the 2-process run_job_global gloo harness (global_worker.py);
-    ``ledger`` attaches telemetry at a shared path (ISSUE 13)."""
+    ``ledger`` attaches telemetry at a shared path (ISSUE 13);
+    ``fault_plan`` arms the executor's injection seams (ISSUE 15 — the
+    process-kill seam is the host-kill chaos scenario)."""
     import os
     import socket
     import subprocess
@@ -222,8 +280,10 @@ def _launch_global_workers(path, ckpt, crash_at, ledger=None,
     worker = str(repo / "tests" / "global_worker.py")
     argv = [sys.executable, worker, "PID", "2", str(port), str(path),
             str(chunk_bytes), "2", str(ckpt), str(crash_at)]
-    if ledger is not None:
-        argv.append(ledger)
+    if ledger is not None or fault_plan is not None:
+        argv.append(ledger or "")
+    if fault_plan is not None:
+        argv.append(fault_plan)
     procs = [subprocess.Popen(argv[:2] + [str(p)] + argv[3:],
                               env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True)
@@ -282,7 +342,7 @@ def test_run_job_global_multiprocess_writes_host_shards(tmp_path):
         recs = list(obs.read_ledger(sp))
         assert all(r.get("host") == h for r in recs)
         start = next(r for r in recs if r["kind"] == "run_start")
-        assert start["ledger_version"] == obs.LEDGER_VERSION == 8
+        assert start["ledger_version"] == obs.LEDGER_VERSION == 9
         assert start["processes"] == 2 and start["local_devices"] == 2
         assert set(start["clock"]) == {"wall", "mono"}
         groups = [r for r in recs if r["kind"] == "group"]

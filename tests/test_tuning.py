@@ -347,7 +347,7 @@ def test_hint_run_emits_one_tune_record(hint_run):
     assert t["signals"]["resource"] == art["bottleneck"]["resource"]
     # run_start stamps the v4 schema the tune record rides on.
     start = next(r for r in recs if r["kind"] == "run_start")
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 8
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
 
 
 @pytest.mark.slow

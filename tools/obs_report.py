@@ -286,6 +286,8 @@ def analyze_run(records: list) -> dict:
     retries = [r for r in records if r["kind"] == "retry"]
     failures = [r for r in records if r["kind"] == "failure"]
     checkpoints = [r for r in records if r["kind"] == "checkpoint"]
+    faults = [r for r in records if r["kind"] == "fault"]
+    degrades = [r for r in records if r["kind"] == "degrade"]
 
     n_steps = sum(r.get("steps", 1) for r in steps)
     bytes_done = sum(r.get("group_bytes", 0) for r in steps)
@@ -335,7 +337,8 @@ def analyze_run(records: list) -> dict:
               ("driver", "job", "devices", "chunk_bytes", "superstep",
                "backend", "map_impl", "combiner", "geometry",
                "geometry_spec", "merge_strategy", "input",
-               "retry", "ledger_version", "host", "processes")} \
+               "retry", "ledger_version", "host", "processes",
+               "fault_plan")} \
         if start else None
     classification = classify(phases)
     # Measured timeline (ISSUE 7): present only when the run carries
@@ -370,6 +373,16 @@ def analyze_run(records: list) -> dict:
     if progress is not None:
         progress = {k: v for k, v in progress.items()
                     if k not in ("ts", "run_id", "kind")}
+    # Reliability verdict (ISSUE 15, ledger v9): present only when the
+    # run carries fault/degrade/retry/failure records AND the classifier
+    # is loadable — a fault-free run has no reliability section, exactly
+    # like a data-record-free run has no data-health section.
+    reliability = None
+    if faults or degrades or retries or failures:
+        dh = _datahealth_mod()
+        if dh is not None and hasattr(dh, "classify_reliability"):
+            reliability = dh.classify_reliability(
+                records, run_id=records[0].get("run_id"))
     return {
         "started_ts": start.get("ts") if start else None,
         "progress": progress,
@@ -399,6 +412,9 @@ def analyze_run(records: list) -> dict:
                       "flight_dump": f.get("flight_dump")} for f in failures],
         "checkpoints": len(checkpoints),
         "compile_s": round(compile_s, 4),
+        "faults": len(faults),
+        "degrades": [d.get("ladder_step") for d in degrades],
+        "reliability": reliability,
     }
 
 
@@ -586,6 +602,22 @@ def render_run(a: dict, out) -> None:
     if a["checkpoints"] or a["retries"]:
         out.write(f"  checkpoints: {a['checkpoints']}  "
                   f"retries: {a['retries']}\n")
+    # Reliability section (ISSUE 15, ledger v9): a degraded-but-alive or
+    # chaos-tested run is VISIBLE, not mysterious.  The header's
+    # fault_plan stamp names the chaos a chaotic run ran under.
+    if (a["header"] or {}).get("fault_plan"):
+        out.write(f"  chaos: fault_plan={a['header']['fault_plan']}\n")
+    r = a.get("reliability")
+    if r and (r.get("verdict") != "clean" or a.get("faults")):
+        out.write(f"  reliability: {r.get('verdict', '?')}")
+        sig = r.get("signals") or {}
+        if sig.get("faults_total"):
+            out.write(f"  ({sig.get('faults_injected', 0)} injected / "
+                      f"{sig.get('faults_real', 0)} real faults)")
+        out.write("\n")
+        for f in r.get("flags", []):
+            out.write(f"  RELIABILITY {f.get('flag', '?')}: "
+                      f"{f.get('detail', '')}\n")
     for s in a["spikes"]:
         out.write(f"  ANOMALY step-time spike: step {s['step']} took "
                   f"{s['elapsed_s']:.3f}s vs median {s['median_s']:.3f}s "
@@ -752,6 +784,12 @@ def compare_runs(a: dict, b: dict) -> list:
         text("combiner", ca, cb)
         num("combiner_rows_deleted", da.get("combiner_rows_deleted"),
             db.get("combiner_rows_deleted"), "{:.0f}")
+    ra, rb = a.get("reliability") or {}, b.get("reliability") or {}
+    if ra or rb:
+        # The reliability A/B row (ISSUE 15): did either arm degrade,
+        # absorb faults, or run under a fault plan.
+        text("reliability", ra.get("verdict"), rb.get("verdict"))
+        num("faults", a.get("faults"), b.get("faults"), "{:.0f}")
     return rows
 
 
@@ -842,7 +880,7 @@ def selftest() -> int:
     ledger_b = os.path.join(fdir, "mini_ledger_b.jsonl")
     flight = os.path.join(fdir, "mini_flight.json")
     runs = analyze(ledger)
-    assert len(runs) == 9, f"fixture holds nine runs, got {len(runs)}"
+    assert len(runs) == 10, f"fixture holds ten runs, got {len(runs)}"
     a = runs[0]
     assert a["completed"], "fixture run has a run_end record"
     assert a["steps"] == 6 and a["step_records"] == 6, \
@@ -948,7 +986,38 @@ def selftest() -> int:
     assert p9["header"]["host"] == 0 and p9["header"]["processes"] == 2, \
         p9["header"]
     assert p9["completed"] and p9["timeline"]["groups"] == 2, p9["timeline"]
-    # Run 8 in file order (ISSUE 8): a spill-heavy pallas run carrying
+    # Run 8 in file order (ISSUE 15): a ledger-v9 CHAOTIC run — a fault
+    # plan fired two injected faults (dispatch crossing 2, token-wait
+    # crossing 1), the transient one was absorbed by a retry, and the
+    # resource one stepped the degradation ladder twice (tall512 ->
+    # default geometry, then combiner off).  Hand arithmetic: 2 injected
+    # / 0 real faults, retries {transient: 1, resource: 1}, verdict
+    # `degraded` (degraded outranks chaos-tested in RELIABILITY_ORDER),
+    # and the run_start fault_plan stamp must round-trip.
+    ch = runs[7]
+    assert ch["header"]["ledger_version"] == 9, ch["header"]
+    assert ch["header"]["fault_plan"] \
+        == "at=dispatch:2:transient,at=token-wait:1:resource", ch["header"]
+    assert ch["completed"], "the chaotic run finished — degraded, alive"
+    assert ch["faults"] == 2, ch["faults"]
+    assert ch["degrades"] == ["revert-geometry", "combiner-off"], \
+        ch["degrades"]
+    rel = ch["reliability"]
+    assert rel is not None and rel["verdict"] == "degraded", rel
+    rsig = rel["signals"]
+    assert rsig["faults_injected"] == 2 and rsig["faults_real"] == 0, rsig
+    assert rsig["retries"] == 2 and rsig["retries_by_class"] \
+        == {"transient": 1, "resource": 1}, rsig
+    assert rsig["degrade_steps"] == ["revert-geometry", "combiner-off"]
+    relflags = {f["flag"] for f in rel["flags"]}
+    assert relflags == {"degraded", "chaos-tested"}, relflags
+    # Fault-free runs carry NO reliability section at all (the section
+    # only exists when there is something to report) — except fixture01,
+    # whose single pre-taxonomy retry record classifies clean.
+    assert c["reliability"] is None and d["reliability"] is None
+    assert a["reliability"] is not None \
+        and a["reliability"]["verdict"] == "clean", a["reliability"]
+    # Run 9 in file order (ISSUE 8): a spill-heavy pallas run carrying
     # per-group `data` dicts and the per-run `data` record.  Checked
     # against the arithmetic done by hand on the fixture: 3 of 6 chunks
     # took the full-resolution fallback (fallback_frac 0.5 > the 5%
@@ -957,7 +1026,7 @@ def selftest() -> int:
     # the 5% gate), and 20 distinct keys spilled — so the verdict is
     # spill-bound with rescue-heavy and table-pressure riding along, and
     # nothing else.
-    e = runs[7]
+    e = runs[8]
     assert e["header"]["ledger_version"] == 3, e["header"]
     assert e["data"] is not None and e["data"]["fallback_chunks"] == 3
     eh = e["data_health"]
@@ -975,7 +1044,8 @@ def selftest() -> int:
     egroups = [r for r in read_ledger(ledger)
                if r.get("kind") == "group" and r.get("run_id") == "fixture05"]
     assert all("data" in g for g in egroups), egroups
-    assert all(runs[i]["tune"] is None for i in (0, 1, 2, 3, 5, 6, 7, 8)), \
+    assert all(runs[i]["tune"] is None
+               for i in (0, 1, 2, 3, 5, 6, 7, 8, 9)), \
         "runs without a tune record must carry None"
     # Run 9 in file order (ISSUE 14): a ledger-v8 run still IN FLIGHT —
     # no run_end, but two `progress` heartbeat records.  Hand arithmetic:
@@ -983,7 +1053,7 @@ def selftest() -> int:
     # report must surface the last heartbeat instead of a bare DID NOT
     # COMPLETE, and the status classifier must read in-flight (no
     # failure record), not crashed.
-    w = runs[8]
+    w = runs[9]
     assert w["header"]["ledger_version"] == 8, w["header"]
     assert not w["completed"] and w["failure_count"] == 0
     assert w["progress"]["frac"] == 0.5, w["progress"]
@@ -992,7 +1062,7 @@ def selftest() -> int:
     # --list-runs (ISSUE 14 satellite): one row per instance with the
     # stamps and status — where --run-id ids come from.
     lrows = list_runs(ledger)
-    assert len(lrows) == 9, lrows
+    assert len(lrows) == 10, lrows
     byid = {r["run_id"]: r for r in lrows}
     assert byid["fixture10"]["status"] == "in-flight"
     assert byid["fixture10"]["cursor_frac"] == 0.5
@@ -1005,7 +1075,7 @@ def selftest() -> int:
     render_list(lrows, lbuf)
     ltext = lbuf.getvalue()
     assert "fixture10" in ltext and "in-flight @50%" in ltext, ltext
-    assert ltext.count("\n") == 9, ltext
+    assert ltext.count("\n") == 10, ltext
     # --run-id (ISSUE 13 satellite): an append-mode ledger's compare pick
     # honors an explicit selector instead of always the last completed
     # run, and an absent id is an honest miss, not a silent fallback.
@@ -1042,6 +1112,7 @@ def selftest() -> int:
     render_run(h8, buf)
     render_run(f6, buf)
     render_run(p9, buf)
+    render_run(ch, buf)
     render_run(w, buf)
     render_flight(flight, buf)
     body = buf.getvalue()
@@ -1066,6 +1137,15 @@ def selftest() -> int:
     assert "DATA spill-bound" in body and "DATA rescue-heavy" in body
     assert "spill fallbacks 3" in body
     assert "tune: raise-prefetch — prefetch_depth 4 -> 8" in body
+    # The reliability section (ISSUE 15): a degraded-but-alive chaos run
+    # is rendered visibly — plan stamp, verdict, the ladder walked.
+    assert ("chaos: fault_plan=at=dispatch:2:transient,"
+            "at=token-wait:1:resource") in body, body
+    assert "reliability: degraded  (2 injected / 0 real faults)" in body, \
+        body
+    assert "RELIABILITY degraded" in body \
+        and "revert-geometry -> combiner-off" in body, body
+    assert "RELIABILITY chaos-tested" in body, body
     # A/B ledger diffing (ISSUE 8 satellite): the spill-heavy run vs the
     # clean uniform counterpart must render one table naming both data
     # verdicts, and the machine-readable form must carry the rows.
@@ -1126,6 +1206,11 @@ def selftest() -> int:
     # opaque trail) must pass through and render without error (ISSUE 10
     # forward compat).
     assert f["tune"] is not None and f["tune"]["rule"] == "warp-rebalance"
+    # The future-shaped fault/degrade records (unknown fault class,
+    # unknown ladder step) must classify without error (ISSUE 15 forward
+    # compat): an injected fault + a degrade step read `degraded`.
+    assert f["reliability"] is not None \
+        and f["reliability"]["verdict"] == "degraded", f["reliability"]
     # The future-shaped geometry stamp (a spec dict where the label
     # string lives today) must surface and render without error.
     assert f["header"]["geometry"] == {"block_rows": 1024,
@@ -1140,6 +1225,7 @@ def selftest() -> int:
           f"data health={eh['verdict']}, tune rule={tn['rule']}, "
           f"geometry={f6['header']['geometry']}, "
           f"fleet={fview['fleet_bottleneck']['verdict']}, "
+          f"reliability={rel['verdict']}, "
           "run-id selector ok, compare ok, future-ledger ok)")
     return 0
 

@@ -50,4 +50,9 @@ timeout -k 5 60 python tools/autotune.py --selftest || { echo "TIER1: autotune s
 # asserted, and the tuner's geometry knob walked over its fixtures —
 # jax-free, seconds.
 timeout -k 5 60 python tools/geomsearch.py --selftest || { echo "TIER1: geomsearch selftest FAILED"; exit 1; }
+# Chaos gate (ISSUE 15): the failure-policy backoff/taxonomy/ladder
+# arithmetic against hand-computed values, fault-plan determinism and
+# spec round-trip, and the replay-from-ledger contract over the
+# checked-in chaotic fixture run — jax-free, seconds.
+timeout -k 5 60 python tools/chaos.py --selftest || { echo "TIER1: chaos selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
