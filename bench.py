@@ -33,6 +33,9 @@ BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
 BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_COMBINER / BENCH_GEOMETRY /
 BENCH_MERGE_EVERY /
+BENCH_MERGE_STRATEGY (tree / gather / keyrange — the reduction seam the
+static planner `tools/redplan.py` ranks; keyrange is the planner's
+skew-sensitive alternative) /
 BENCH_COMPACT_SLOTS /
 BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
 so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
@@ -580,7 +583,9 @@ def main() -> int:
                                 else None))
     mesh = data_mesh()
     n_dev = mesh.devices.size
-    engine = Engine(WordCountJob(cfg), mesh)
+    engine = Engine(WordCountJob(cfg), mesh,
+                    merge_strategy=os.environ.get("BENCH_MERGE_STRATEGY",
+                                                  "tree"))
 
     with tempfile.NamedTemporaryFile(dir="/tmp", suffix=".txt", delete=False) as f:
         f.write(corpus)

@@ -192,11 +192,25 @@ class AnalysisContext:
                  property_samples: int = 3,
                  baselines_dir: Optional[str] = None,
                  write_baselines: bool = False):
-        from mapreduce_tpu.parallel.mesh import data_mesh
+        from mapreduce_tpu.parallel.mesh import data_mesh, two_level_mesh
 
         self.job = job
         self.model = model
-        self.mesh = mesh if mesh is not None else data_mesh()
+        # ``analysis_fleet`` (the *_fleet registry twins): the job declares
+        # the SIMULATED fleet topology it must be certified over —
+        # {"processes": P, "local_devices": L}.  It wins over the caller's
+        # mesh (the CLI builds one shared single-host mesh for every
+        # model): a 2-D process-major mesh when L > 1 (outer axis rides
+        # DCN, parallel/mesh.two_level_mesh contract), a flat mesh of P
+        # otherwise.  The collective-cost pass reads ``self.fleet`` to
+        # attribute link levels.
+        self.fleet = dict(getattr(job, "analysis_fleet", None) or {})
+        if self.fleet:
+            p = int(self.fleet.get("processes", 1))
+            ld = int(self.fleet.get("local_devices", 1))
+            self.mesh = two_level_mesh(p, ld) if ld > 1 else data_mesh(p)
+        else:
+            self.mesh = mesh if mesh is not None else data_mesh()
         self.corpus_bytes = int(corpus_bytes)
         self.property_chunk_bytes = int(property_chunk_bytes)
         self.property_samples = int(property_samples)

@@ -1,0 +1,428 @@
+"""Hierarchical link model: pricing collective schedules over a mesh.
+
+The ``collective`` byte family the costmodel tallies but deliberately never
+prices (:mod:`mapreduce_tpu.analysis.costmodel`: "they price interconnect,
+not local HBM") finally gets a cost.  The model is the classical
+alpha-beta decomposition over a THREE-level link hierarchy — intra-chip
+HBM, the ICI ring within a slice/host, DCN across hosts — with per-level
+bandwidth+latency read from a checked-in measured-rates fixture
+(``analysis/baselines/measured_link_rates.json``, next to the HBM/sort
+fixture the hbm-cost pass already cross-checks against).
+
+Like the byte model it completes, this is a stable, auditable BOUND, not
+a simulator: every schedule is priced as ``rounds * alpha + bytes/beta``
+per link level, congestion-free.  The schedules priced are exactly the
+ones the runtime builds (:mod:`mapreduce_tpu.parallel.collectives` — the
+``STRATEGIES`` descriptors there must stay in bijection with
+:data:`STRATEGIES` here; a test asserts it):
+
+* **ring all-reduce** — ``2(D-1) alpha + 2 (D-1)/D * M/beta`` (XLA's
+  native ``psum`` lowering: reduce-scatter + all-gather rings);
+* **butterfly tree** — ``log2(D) * (alpha + M/beta)``: the
+  ``tree_merge`` ppermute butterfly, full payload every round;
+* **all-gather + fold** — ``alpha + (D-1) M/beta``: ``gather_merge``;
+* **reduce-scatter** — ``alpha + (D-1)/D * M/beta``;
+* **keyrange all-to-all** — ``2 alpha + 2 s M/beta``: one budgeted
+  ``all_to_all`` (s*M with slack s) + one all-gather of the reduced
+  blocks (``key_range_merge``'s traffic table);
+* **2-D hierarchical** — inner (ICI) level first, then the outer (DCN)
+  level with the already-merged payload (``hierarchical_merge``).
+
+The ring-vs-tree crossover — tree wins small payloads (fewer
+latency-bound rounds at the front), ring wins large ones (moves
+``2(D-1)/D`` of the bytes instead of ``log2 D`` times the bytes) — is
+closed-form here (:func:`ring_tree_crossover_bytes`; at D=4 it reduces
+to ``M* = 8 alpha beta``), the hand-checkable arithmetic
+``tools/redplan.py --selftest`` gates in tier-1.
+
+Deliberately jax-free and stdlib-only: the planner loads this module by
+file path (the ``analysis/geometry.py`` precedent) so the tier-1
+selftest runs without importing jax; the collective-cost pass imports it
+normally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Optional, Sequence
+
+_BASELINES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "baselines")
+LINK_RATES_PATH = os.path.join(_BASELINES_DIR, "measured_link_rates.json")
+
+#: CountTable wire footprint: 7 uint32 planes (key_hi/key_lo/count/
+#: count_hi/pos_hi/pos_lo/length) per slot; the dropped_* scalars are
+#: noise.  The payload unit every strategy moves.
+TABLE_PLANES = 7
+
+#: Top single-key mass past which keyrange's hot-owner derating applies
+#: (obs/datahealth.TOP_MASS_HOT — kept literal so this module stays
+#: loadable by file path with no package import).
+TOP_MASS_HOT = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class Link:
+    """One link level: per-hop latency (seconds) + bandwidth (bytes/s)."""
+
+    name: str
+    alpha_s: float
+    beta_bps: float
+
+    def time(self, payload_bytes: float, rounds: int = 1) -> float:
+        """``rounds * alpha + payload/beta`` — the alpha-beta unit."""
+        return rounds * self.alpha_s + payload_bytes / self.beta_bps
+
+
+def load_link_rates(path: Optional[str] = None) -> dict:
+    """The measured link fixture -> ``{"levels": {name: Link},
+    "keyrange_slack": float}``."""
+    with open(path or LINK_RATES_PATH) as f:
+        raw = json.load(f)
+    levels = {name: Link(name=name, alpha_s=float(spec["alpha_s"]),
+                         beta_bps=float(spec["beta_gbps"]) * 1e9)
+              for name, spec in raw["levels"].items()}
+    return {"levels": levels,
+            "keyrange_slack": float(raw.get("keyrange_slack", 2.0))}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxis:
+    """One mesh axis with the link level its collectives ride."""
+
+    name: str
+    size: int
+    level: str  # 'ici' | 'dcn' (hbm is the intra-chip degenerate case)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A mesh shape with link-level attribution, outermost axis first.
+
+    The runtime contract (``parallel/mesh.two_level_mesh``): devices are
+    process-major, so the OUTER axis crosses the process (host/slice)
+    boundary and rides DCN, inner axes ride ICI.  A single-host mesh is
+    all-ICI.
+    """
+
+    axes: tuple  # tuple[MeshAxis, ...]
+
+    @classmethod
+    def single_host(cls, n_devices: int, axis: str = "data") -> "MeshSpec":
+        return cls(axes=(MeshAxis(axis, int(n_devices), "ici"),))
+
+    @classmethod
+    def fleet(cls, processes: int, local_devices: int,
+              axes: Sequence[str] = ("replica", "data")) -> "MeshSpec":
+        return cls(axes=(MeshAxis(axes[0], int(processes), "dcn"),
+                         MeshAxis(axes[1], int(local_devices), "ici")))
+
+    @classmethod
+    def from_mesh(cls, axis_names: Sequence[str], axis_sizes: Sequence[int],
+                  processes: int = 1) -> "MeshSpec":
+        """Attribute a traced mesh's axes: with >1 process the outermost
+        axis crosses the host boundary (process-major device order)."""
+        axes = []
+        for i, (name, size) in enumerate(zip(axis_names, axis_sizes)):
+            level = "dcn" if processes > 1 and i == 0 else "ici"
+            axes.append(MeshAxis(str(name), int(size), level))
+        return cls(axes=tuple(axes))
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(a.size for a in self.axes)
+
+    def axis(self, name: str) -> Optional[MeshAxis]:
+        for a in self.axes:
+            if a.name == name:
+                return a
+        return None
+
+    def slowest_level(self) -> str:
+        return "dcn" if any(a.level == "dcn" for a in self.axes) else "ici"
+
+    def label(self) -> str:
+        return "x".join(f"{a.size}{'d' if a.level == 'dcn' else 'i'}"
+                        for a in self.axes)
+
+
+def table_bytes(capacity: int) -> int:
+    """CountTable wire bytes at a capacity: 7 uint32 planes."""
+    return TABLE_PLANES * 4 * int(capacity)
+
+
+# -- per-schedule alpha-beta pricing (one level, D participants) -------------
+
+
+def allreduce_ring(m: float, d: int, link: Link) -> float:
+    """Ring all-reduce (reduce-scatter + all-gather rings): 2(D-1) hops,
+    each moving M/D — XLA's native ``psum`` schedule."""
+    if d <= 1:
+        return 0.0
+    return link.time(2 * (d - 1) / d * m, rounds=2 * (d - 1))
+
+
+def allreduce_tree(m: float, d: int, link: Link) -> float:
+    """Butterfly (recursive-doubling) all-reduce: log2(D) rounds, FULL
+    payload every round — ``collectives.tree_merge``."""
+    if d <= 1:
+        return 0.0
+    rounds = max(1, math.ceil(math.log2(d)))
+    return link.time(rounds * m, rounds=rounds)
+
+
+def allgather(m: float, d: int, link: Link) -> float:
+    """One all-gather of every participant's full M: receive (D-1)*M —
+    ``collectives.gather_merge``'s wire cost (the fold is local)."""
+    if d <= 1:
+        return 0.0
+    return link.time((d - 1) * m, rounds=1)
+
+
+def reduce_scatter(m: float, d: int, link: Link) -> float:
+    """Ring reduce-scatter: (D-1) hops of M/D."""
+    if d <= 1:
+        return 0.0
+    return link.time((d - 1) / d * m, rounds=d - 1)
+
+
+def all_to_all(m: float, d: int, link: Link) -> float:
+    """One all-to-all: each participant ships (D-1)/D of its M."""
+    if d <= 1:
+        return 0.0
+    return link.time((d - 1) / d * m, rounds=1)
+
+
+def keyrange(m: float, d: int, link: Link, slack: float = 2.0) -> float:
+    """``key_range_merge``: one budgeted all-to-all (s*M with slack s) +
+    one all-gather of the already-reduced blocks (s*M) — the traffic
+    table in its docstring, priced at the slowest link the flattened
+    axis crosses."""
+    if d <= 1:
+        return 0.0
+    return link.time(slack * m, rounds=1) + link.time(slack * m, rounds=1)
+
+
+def ring_tree_crossover_bytes(d: int, link: Link) -> float:
+    """Payload M* where ring and butterfly all-reduce cost the same:
+    ``M* = alpha*beta * (2(D-1) - log2 D) / (log2 D - 2(D-1)/D)``.
+    Below M* the butterfly's fewer latency rounds win; above it the
+    ring's 2(D-1)/D byte factor wins.  At D=4 this is ``8*alpha*beta``
+    — the hand arithmetic the redplan selftest asserts."""
+    if d < 4:  # at D=2 both schedules move M in 1-2 rounds; no crossover
+        return math.inf
+    log_d = math.ceil(math.log2(d))
+    num = 2 * (d - 1) - log_d
+    den = log_d - 2 * (d - 1) / d
+    if den <= 0:
+        return math.inf
+    return link.alpha_s * link.beta_bps * num / den
+
+
+#: Collective primitive -> (schedule fn, human schedule name).  What the
+#: collective-cost pass prices each traced eqn with.  ``psum``-family
+#: prims ride XLA's native ring; ``all_gather``/``reduce_scatter``/
+#: ``all_to_all`` price as themselves; ``ppermute`` is one round of M.
+_PRIM_SCHEDULES = {
+    "psum": (allreduce_ring, "ring-allreduce"),
+    "pmax": (allreduce_ring, "ring-allreduce"),
+    "pmin": (allreduce_ring, "ring-allreduce"),
+    "pbroadcast": (allreduce_tree, "broadcast-tree"),
+    "all_gather": (allgather, "all-gather"),
+    "reduce_scatter": (reduce_scatter, "reduce-scatter"),
+    "psum_scatter": (reduce_scatter, "reduce-scatter"),
+    "all_to_all": (all_to_all, "all-to-all"),
+    "ppermute": (lambda m, d, link: link.time(m, rounds=1) if d > 1 else 0.0,
+                 "ppermute-round"),
+}
+
+COLLECTIVE_PRIMS = frozenset(_PRIM_SCHEDULES) | {"axis_index"}
+
+
+def price_eqn(prim: str, payload_bytes: int, axis_names: Sequence[str],
+              mesh: MeshSpec, levels: dict) -> Optional[dict]:
+    """Model one traced collective equation: per-axis alpha-beta seconds
+    at the axis's link level.  Multi-axis collectives price each level
+    sequentially with the full payload (conservative).  Returns None for
+    communication-free prims (``axis_index``) or unknown axes."""
+    if prim not in _PRIM_SCHEDULES:
+        return None
+    fn, schedule = _PRIM_SCHEDULES[prim]
+    per_axis = []
+    total = 0.0
+    for name in axis_names:
+        ax = mesh.axis(name)
+        if ax is None:
+            return None
+        link = levels[ax.level]
+        s = fn(float(payload_bytes), ax.size, link)
+        per_axis.append({"axis": name, "d": ax.size, "level": ax.level,
+                         "seconds": s})
+        total += s
+    if not per_axis:
+        return None
+    return {"schedule": schedule, "seconds": total, "per_axis": per_axis}
+
+
+# -- reduction-strategy descriptors + pricing --------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """One reduction strategy the planner enumerates — named EXACTLY
+    after the runtime builder in ``parallel/collectives.py`` (Engine
+    ``merge_strategy`` values; a test asserts the bijection)."""
+
+    name: str
+    builder: str  # dotted runtime location, for the artifact/doc trail
+    power_of_two_only: bool = False
+    needs_keyrange_hook: bool = False
+    description: str = ""
+
+
+STRATEGIES = {
+    "tree": Strategy(
+        name="tree",
+        builder="mapreduce_tpu.parallel.collectives.tree_merge",
+        power_of_two_only=True,
+        description="butterfly ppermute all-reduce, log2(D) full-payload "
+                    "rounds per axis (innermost level first on 2-D "
+                    "meshes); non-power-of-two axes fall back to gather"),
+    "gather": Strategy(
+        name="gather",
+        builder="mapreduce_tpu.parallel.collectives.gather_merge",
+        description="all_gather every state + local fold; any axis size, "
+                    "O(D) memory, (D-1)*M wire bytes per axis"),
+    "keyrange": Strategy(
+        name="keyrange",
+        builder="mapreduce_tpu.parallel.collectives.key_range_merge",
+        needs_keyrange_hook=True,
+        description="key-range reduce-scatter: one budgeted all_to_all + "
+                    "owner reduce + all_gather of reduced blocks, over "
+                    "the FLATTENED axis (trades the ICI/DCN hierarchy "
+                    "for a single scheduled collective)"),
+}
+
+
+def keyrange_budget_rows(capacity: int, d: int, slack: float) -> int:
+    """``key_range_merge``'s per-destination row budget B (its docstring
+    formula, reproduced so the planner's spill-risk arithmetic can never
+    drift silently from the runtime — a test pins them equal)."""
+    if d <= 1:
+        return int(capacity)
+    return min(int(capacity),
+               -(-int(slack * capacity) // d) + 8 + 4 * (d - 1).bit_length())
+
+
+def price_strategy(name: str, payload_bytes: int, mesh: MeshSpec,
+                   levels: dict, slack: float = 2.0) -> dict:
+    """Model one strategy end to end over a mesh: per-level schedule
+    seconds, innermost-first for the hierarchical strategies (the
+    ``hierarchical_merge`` order), flattened-axis for keyrange."""
+    strat = STRATEGIES[name]
+    per_level = []
+    total = 0.0
+    notes = []
+    m = float(payload_bytes)
+    if name == "keyrange":
+        d = mesh.n_devices
+        level = mesh.slowest_level()
+        link = levels[level]
+        s = keyrange(m, d, link, slack=slack)
+        per_level.append({"axis": "<flattened>", "d": d, "level": level,
+                          "schedule": "keyrange-a2a", "seconds": s})
+        total = s
+    else:
+        # hierarchical_merge order: innermost (fast) axis first, so the
+        # outer (slow) level moves one already-merged payload per group.
+        for ax in reversed(mesh.axes):
+            link = levels[ax.level]
+            if name == "tree":
+                if ax.size & (ax.size - 1):
+                    s = allgather(m, ax.size, link)
+                    sched = "all-gather (non-power-of-two fallback)"
+                    notes.append(f"axis {ax.name!r} (D={ax.size}) is not a "
+                                 "power of two: tree_merge falls back to "
+                                 "gather there")
+                else:
+                    s = allreduce_tree(m, ax.size, link)
+                    sched = "butterfly-tree"
+            else:
+                s = allgather(m, ax.size, link)
+                sched = "all-gather+fold"
+            per_level.append({"axis": ax.name, "d": ax.size,
+                              "level": ax.level, "schedule": sched,
+                              "seconds": s})
+            total += s
+    return {"strategy": name, "builder": strat.builder,
+            "modeled_s": total, "per_level": per_level, "notes": notes}
+
+
+def plan(processes: int, local_devices: int, capacity: int, *,
+         rates: Optional[dict] = None, top_mass: Optional[float] = None,
+         table_occupancy: Optional[float] = None,
+         has_keyrange_hook: bool = True,
+         incumbent: Optional[str] = None) -> dict:
+    """Enumerate + price + rank every feasible reduction strategy for a
+    fleet shape — the planner core ``tools/redplan.py`` drives.
+
+    ``top_mass``/``table_occupancy`` (a prior run's measured key
+    distribution, via ``obs/history.resolve_prior``) derate keyrange:
+    past ``TOP_MASS_HOT`` the hot key's owner partition is the reduce's
+    critical path (modeled_s scaled by ``1 + top_mass``), and a
+    partition load near the budget B flags spill risk (exactness holds
+    — spilled keys are fully evicted per the runtime contract — but a
+    spilling merge is a different result surface than tree/gather's).
+    """
+    rates = rates or load_link_rates()
+    levels, slack = rates["levels"], rates["keyrange_slack"]
+    mesh = MeshSpec.fleet(processes, local_devices) if processes > 1 \
+        else MeshSpec.single_host(local_devices)
+    payload = table_bytes(capacity)
+    ranked = []
+    skipped = []
+    for name, strat in STRATEGIES.items():
+        if strat.needs_keyrange_hook and not has_keyrange_hook:
+            skipped.append({"strategy": name,
+                            "why": "job has no keyrange_merge hook"})
+            continue
+        priced = price_strategy(name, payload, mesh, levels, slack=slack)
+        if name == "keyrange":
+            d = mesh.n_devices
+            budget = keyrange_budget_rows(capacity, d, slack)
+            priced["keyrange_budget_rows"] = budget
+            if top_mass is not None and top_mass > TOP_MASS_HOT:
+                priced["modeled_s"] *= 1.0 + float(top_mass)
+                priced["notes"].append(
+                    f"skew derating x{1 + top_mass:.2f}: measured "
+                    f"top_mass {top_mass:.2f} > {TOP_MASS_HOT} puts the "
+                    "hot key's owner partition on the critical path")
+            if table_occupancy is not None and d > 1 \
+                    and table_occupancy * capacity / d > 0.8 * budget:
+                priced["spill_risk"] = True
+                priced["notes"].append(
+                    f"partition load ~{table_occupancy * capacity / d:.0f} "
+                    f"rows nears the budget B={budget}: budget spill "
+                    "(exact, but a different result surface) is likely")
+        priced["modeled_s"] = round(priced["modeled_s"], 9)
+        for lv in priced["per_level"]:
+            lv["seconds"] = round(lv["seconds"], 9)
+        ranked.append(priced)
+    ranked.sort(key=lambda p: (p["modeled_s"], p["strategy"]))
+    return {
+        "mesh": {"processes": int(processes),
+                 "local_devices": int(local_devices),
+                 "devices": mesh.n_devices, "label": mesh.label()},
+        "capacity": int(capacity),
+        "payload_bytes": payload,
+        "keyrange_slack": slack,
+        "ranked": ranked,
+        "skipped": skipped,
+        "top": ranked[0]["strategy"] if ranked else None,
+        "incumbent": incumbent,
+        "incumbent_is_top": (incumbent == ranked[0]["strategy"]
+                             if ranked and incumbent else None),
+    }

@@ -2,7 +2,7 @@
 
 from mapreduce_tpu.analysis.passes import (algebra, overflow, hostsync,
                                            sharding, cost, vmem, kernelrace,
-                                           fusion)
+                                           fusion, collective)
 
 __all__ = ["algebra", "overflow", "hostsync", "sharding", "cost", "vmem",
-           "kernelrace", "fusion"]
+           "kernelrace", "fusion", "collective"]

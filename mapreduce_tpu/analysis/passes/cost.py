@@ -114,15 +114,42 @@ class CostPass:
             report["geometry"] = config.geometry_label
 
         step_cost = None
+        collective_bytes: dict = {}
         for hook, traced in ctx.engine_traces.items():
             if isinstance(traced, trace.TraceFailure):
                 continue  # the sharding pass owns trace-failure reporting
             cost = costmodel.program_cost(traced)
             report["programs"][hook] = cost.as_dict()
+            collective_bytes[hook] = cost.collective_bytes
             if hook == "step":
                 step_cost = cost
         if step_cost is None:
             return out  # nothing traced; nothing to certify
+
+        # The collective family, surfaced instead of silently excluded
+        # (ISSUE 16): these bytes price interconnect, not local HBM, so
+        # they stay out of effective_input_passes — but a report that
+        # omits them under-states the program's traffic.  ``priced`` stays
+        # False here; the collective-cost pass flips it (and attaches the
+        # modeled seconds) when it has mesh/link context.
+        total_coll = sum(collective_bytes.values())
+        report["collective"] = {
+            "per_program_bytes": collective_bytes,
+            "total_bytes": total_coll,
+            "priced": False,
+            "note": "interconnect bytes, excluded from the HBM total; "
+                    "priced by the collective-cost pass (meshcost link "
+                    "model) when mesh context is available"}
+        if total_coll:
+            out.append(core.Finding(
+                severity=core.INFO, pass_id=self.pass_id, model=ctx.model,
+                hook="finish" if collective_bytes.get("finish") else "step",
+                message=(f"collective family: {total_coll >> 10} KiB "
+                         "interconnect traffic "
+                         f"({', '.join(f'{h}={b}' for h, b in sorted(collective_bytes.items()))} bytes), "
+                         "excluded from the HBM total"),
+                hint="the collective-cost pass prices these bytes per "
+                     "link level (ICI/DCN) via analysis/meshcost.py"))
 
         passes = step_cost.hbm_bytes / max(chunk_bytes, 1)
         report["effective_input_passes"] = round(passes, 3)

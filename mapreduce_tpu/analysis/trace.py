@@ -123,9 +123,14 @@ def trace_engine(job: Any, mesh) -> dict:
         # ``analysis_data_stats`` (registry: the *_telemetry models): trace
         # the INSTRUMENTED step — data-plane counters returned next to the
         # state (ISSUE 8) — so the cost/host-sync passes certify exactly
-        # the program telemetered runs dispatch.
+        # the program telemetered runs dispatch.  ``analysis_merge_strategy``
+        # (the *_fleet twins) likewise selects the Engine merge the traced
+        # finish program builds — keyrange twins certify the all_to_all
+        # program, not the default butterfly.
         eng = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
-                     data_stats=getattr(job, "analysis_data_stats", False))
+                     data_stats=getattr(job, "analysis_data_stats", False),
+                     merge_strategy=getattr(job, "analysis_merge_strategy",
+                                            "tree"))
     except Exception as e:
         f = TraceFailure.of("engine", e)
         return {"step": f, "finish": f}

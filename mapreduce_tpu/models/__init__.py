@@ -179,6 +179,41 @@ def _wordcount_fused_telemetry(config: Config):
     return _instrumented(WordCountJob(FUSED_ANALYSIS_CONFIG))
 
 
+def _fleet(job, processes: int, local_devices: int, merge: str = "tree"):
+    """Mark a job so analysis certifies it over a SIMULATED fleet
+    topology (ISSUE 16): ``analysis_fleet`` makes ``AnalysisContext``
+    build the process-major mesh (outer axis rides DCN) and lets the
+    collective-cost pass attribute link levels; ``analysis_merge_strategy``
+    selects the Engine merge the traced finish program builds."""
+    job.analysis_fleet = {"processes": processes,
+                          "local_devices": local_devices}
+    job.analysis_merge_strategy = merge
+    return job
+
+
+def _wordcount_fleet2(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config (see _wordcount_radix): the 2-host x 4-device fleet
+    # twin — the hierarchical tree merge's butterfly runs per level
+    # (inner ICI axis first, one merged payload across DCN), so the
+    # collective-cost pass prices a real 2-D ICI/DCN program in CI.
+    del config
+    return _fleet(WordCountJob(ANALYSIS_CONFIG), processes=2,
+                  local_devices=4)
+
+
+def _wordcount_fleet8(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config: the 8-host x 1-device twin on the keyrange merge —
+    # the budgeted all_to_all + owner-reduce + all_gather program over a
+    # flattened all-DCN axis, the other end of the planner's tradeoff.
+    del config
+    return _fleet(WordCountJob(ANALYSIS_CONFIG), processes=8,
+                  local_devices=1, merge="keyrange")
+
+
 _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount": _wordcount,
     "grep": _grep,
@@ -192,6 +227,8 @@ _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount_nocombiner": _wordcount_nocombiner,
     "wordcount_telemetry": _wordcount_telemetry,
     "wordcount_fused_telemetry": _wordcount_fused_telemetry,
+    "wordcount_fleet2": _wordcount_fleet2,
+    "wordcount_fleet8": _wordcount_fleet8,
 }
 
 

@@ -246,3 +246,32 @@ def hierarchical_merge(state: T, merge: MergeFn, axes: tuple[str, ...],
     for axis in reversed(axes):
         state = fn(state, merge, axis)
     return state
+
+
+# Reduction-strategy descriptors (ISSUE 16): the machine-readable surface
+# the static planner enumerates.  Names are the Engine ``merge_strategy``
+# values; ``builder`` is the function this module actually dispatches.
+# ``analysis/meshcost.py`` (jax-free, so it cannot import this module)
+# carries a mirrored table with the same names/builders/constraints — a
+# test asserts the two stay in bijection, so the planner can never rank a
+# strategy the runtime does not build (or miss one it does).
+STRATEGIES: dict[str, dict] = {
+    "tree": {
+        "builder": f"{__name__}.tree_merge",
+        "power_of_two_only": True,  # non-pow2 axes fall back to gather
+        "needs_keyrange_hook": False,
+        "per_axis": True,  # hierarchical_merge runs it innermost-first
+    },
+    "gather": {
+        "builder": f"{__name__}.gather_merge",
+        "power_of_two_only": False,
+        "needs_keyrange_hook": False,
+        "per_axis": True,
+    },
+    "keyrange": {
+        "builder": f"{__name__}.key_range_merge",
+        "power_of_two_only": False,
+        "needs_keyrange_hook": True,  # Engine requires job.keyrange_merge
+        "per_axis": False,  # flattens the whole mesh into one collective
+    },
+}

@@ -1,0 +1,289 @@
+"""Mesh-aware collective analysis (ISSUE 16): meshcost link model,
+collective-cost pass + SPMD divergence lint, planner descriptors.
+
+Three layers under test:
+
+* ``analysis/meshcost.py`` — the alpha-beta schedule arithmetic against
+  hand-computed values (crossover, budget rows, plan rankings), and the
+  strategy-descriptor bijection with the runtime builders in
+  ``parallel/collectives.py``;
+* the ``collective-cost`` pass — the priced artifact over the fleet
+  registry twins, the hbm-cost artifact's ``collective.priced`` marker
+  flip, and one known-bad fixture per divergence-lint failure mode
+  (collective under a device-varying cond, same collective over
+  mismatched axis names, collective in one branch only), each an ERROR
+  with a non-zero exit code;
+* the planner surface — ``tools/redplan.py --selftest`` covers the
+  jax-free half in tier-1/smoke; here the jax-side gate twins stay
+  clean.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mapreduce_tpu import analysis
+from mapreduce_tpu import models as models_mod
+from mapreduce_tpu.analysis import meshcost
+from mapreduce_tpu.analysis.passes.collective import CollectivePass
+from mapreduce_tpu.analysis.passes.cost import CostPass
+from mapreduce_tpu.parallel import collectives
+from mapreduce_tpu.parallel.mesh import data_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return data_mesh(8)
+
+
+# -- meshcost arithmetic (jax-free; the redplan selftest's pytest twin) ------
+
+
+@pytest.mark.smoke
+def test_ring_tree_crossover_hand_arithmetic():
+    """M* = 8*alpha*beta at D=4 (the closed form's hand-checkable case):
+    3.6 MB on the measured ICI rates, with ring == tree == 180us there."""
+    ici = meshcost.load_link_rates()["levels"]["ici"]
+    mstar = meshcost.ring_tree_crossover_bytes(4, ici)
+    assert math.isclose(mstar, 8 * ici.alpha_s * ici.beta_bps)
+    assert math.isclose(mstar, 3.6e6)
+    assert math.isclose(meshcost.allreduce_ring(mstar, 4, ici),
+                        meshcost.allreduce_tree(mstar, 4, ici))
+    assert meshcost.allreduce_tree(mstar / 4, 4, ici) \
+        < meshcost.allreduce_ring(mstar / 4, 4, ici)
+    assert meshcost.allreduce_ring(4 * mstar, 4, ici) \
+        < meshcost.allreduce_tree(4 * mstar, 4, ici)
+    assert meshcost.ring_tree_crossover_bytes(2, ici) == math.inf
+
+
+@pytest.mark.smoke
+def test_plan_rankings_and_skew_derating():
+    """The planner's two fixture shapes: latency-bound 229 KB payload ->
+    gather tops; 917 KB -> tree's log2(D) ICI rounds win; Zipf top_mass
+    0.3 derates keyrange by exactly 1.3x."""
+    p = meshcost.plan(2, 4, 8192)
+    assert [r["strategy"] for r in p["ranked"]] \
+        == ["gather", "tree", "keyrange"]
+    assert p["payload_bytes"] == 7 * 4 * 8192 == 229376
+    p = meshcost.plan(2, 4, 32768, top_mass=0.3, table_occupancy=0.85,
+                      incumbent="tree")
+    assert [r["strategy"] for r in p["ranked"]] \
+        == ["tree", "gather", "keyrange"]
+    assert p["incumbent_is_top"] is True
+    kr = next(r for r in p["ranked"] if r["strategy"] == "keyrange")
+    levels = meshcost.load_link_rates()["levels"]
+    base = meshcost.keyrange(meshcost.table_bytes(32768), 8,
+                             levels["dcn"], slack=2.0)
+    assert math.isclose(kr["modeled_s"], base * 1.3, rel_tol=1e-6)
+    # No keyrange hook -> skipped with a reason, never silently priced.
+    p = meshcost.plan(8, 1, 8192, has_keyrange_hook=False)
+    assert [s["strategy"] for s in p["skipped"]] == ["keyrange"]
+
+
+@pytest.mark.smoke
+def test_strategy_descriptors_bijection_with_runtime():
+    """The planner can never rank a strategy the runtime does not build
+    (or miss one it does): names, builder functions, and feasibility
+    constraints pinned equal across the jax-free mirror."""
+    assert set(meshcost.STRATEGIES) == set(collectives.STRATEGIES)
+    for name, strat in meshcost.STRATEGIES.items():
+        runtime = collectives.STRATEGIES[name]
+        assert strat.builder == runtime["builder"], name
+        assert strat.power_of_two_only == runtime["power_of_two_only"], name
+        assert strat.needs_keyrange_hook == runtime["needs_keyrange_hook"], \
+            name
+        # The dotted path names a real callable in collectives.
+        fn_name = strat.builder.rsplit(".", 1)[-1]
+        assert callable(getattr(collectives, fn_name)), strat.builder
+
+
+@pytest.mark.smoke
+def test_keyrange_budget_rows_matches_runtime_formula():
+    """meshcost's spill arithmetic == key_range_merge's docstring budget
+    B = min(cap, ceil(s*cap/D) + 8 + 4*ceil(log2 D))."""
+    for cap, d in ((8192, 8), (32768, 8), (512, 4), (4096, 3), (8192, 1)):
+        want = cap if d <= 1 else min(
+            cap, -(-int(2.0 * cap) // d) + 8 + 4 * (d - 1).bit_length())
+        assert meshcost.keyrange_budget_rows(cap, d, 2.0) == want, (cap, d)
+
+
+# -- known-bad divergence fixtures (duck-typed MapReduceJobs) ----------------
+
+
+class _ScalarJob:
+    """Minimal correct job (the test_graphcheck fixture shape): count
+    non-pad bytes into one bare uint32 scalar."""
+
+    def init_state(self):
+        return jnp.zeros((), jnp.uint32)
+
+    def map_chunk(self, chunk, chunk_id):
+        return jnp.sum((chunk != 0).astype(jnp.uint32))
+
+    def combine(self, state, update):
+        return state + update
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return state
+
+    def identity(self):
+        return type(self).__name__.lower()
+
+
+class DivergentCollectiveJob(_ScalarJob):
+    """Branches of a device-varying cond execute DIFFERENT collectives
+    (psum vs pmax): participants diverge at the first mismatch — the
+    generic distributed-hang fixture."""
+
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        total = self.map_chunk(chunk, chunk_id)
+        pred = jax.lax.axis_index(axis) == 0  # varying by construction
+        return jax.lax.cond(pred,
+                            lambda t: jax.lax.psum(t, axis),
+                            lambda t: jax.lax.pmax(t, axis),
+                            total)
+
+
+class OneBranchCollectiveJob(_ScalarJob):
+    """A collective in ONE branch of a device-varying cond (the other
+    branch is collective-free): devices taking the empty branch never
+    enter the psum — the canonical SPMD hang."""
+
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        total = self.map_chunk(chunk, chunk_id)
+        pred = jnp.sum(chunk.astype(jnp.uint32)) % 2 == 0  # data-varying
+        return jax.lax.cond(pred,
+                            lambda t: jax.lax.psum(t, axis),
+                            lambda t: t + jnp.uint32(0),
+                            total)
+
+
+class AxisMismatchJob(_ScalarJob):
+    """Both branches psum, but over DIFFERENT mesh axes of the 2-D fleet
+    mesh: device groups disagree on who participates."""
+
+    def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+        total = self.map_chunk(chunk, chunk_id)
+        pred = jnp.sum(chunk.astype(jnp.uint32)) % 2 == 0
+        return jax.lax.cond(pred,
+                            lambda t: jax.lax.psum(t, "data"),
+                            lambda t: jax.lax.psum(t, "replica"),
+                            total)
+
+
+def _errors(report):
+    return [f for f in report.errors if f.pass_id == "collective-cost"]
+
+
+def test_divergent_collectives_flagged(mesh8):
+    report = analysis.analyze_job(DivergentCollectiveJob(),
+                                  "divergent-collective", mesh=mesh8,
+                                  passes=[CollectivePass()])
+    errs = _errors(report)
+    assert errs, report.format_text()
+    assert any("different collective programs" in f.message for f in errs)
+    assert report.exit_code != 0
+
+
+def test_collective_in_one_branch_flagged(mesh8):
+    report = analysis.analyze_job(OneBranchCollectiveJob(),
+                                  "one-branch-collective", mesh=mesh8,
+                                  passes=[CollectivePass()])
+    errs = _errors(report)
+    assert errs, report.format_text()
+    assert any("never enter the collective" in f.message for f in errs)
+    assert report.exit_code != 0
+
+
+def test_axis_mismatch_across_branches_flagged(mesh8):
+    job = AxisMismatchJob()
+    job.analysis_fleet = {"processes": 2, "local_devices": 4}
+    report = analysis.analyze_job(job, "axis-mismatch",
+                                  passes=[CollectivePass()])
+    errs = _errors(report)
+    assert errs, report.format_text()
+    assert any("MISMATCHED axis names" in f.message for f in errs)
+    assert report.exit_code != 0
+
+
+def test_uniform_cond_stays_quiet(mesh8):
+    """The lint's negative space: asymmetric branches under a UNIFORM
+    predicate (every device takes the same path — the spill-fallback
+    shape every shipped model relies on) must not flag."""
+
+    class UniformCondJob(_ScalarJob):
+        def map_chunk_sharded(self, chunk, chunk_id, axis, device_index):
+            total = self.map_chunk(chunk, chunk_id)
+            # Reduced first: the predicate is identical on every device.
+            reduced = jax.lax.psum(total, axis)
+            return jax.lax.cond(reduced > 0,
+                                lambda t: jax.lax.psum(t, axis),
+                                lambda t: t + jnp.uint32(0),
+                                total)
+
+    report = analysis.analyze_job(UniformCondJob(), "uniform-cond",
+                                  mesh=mesh8, passes=[CollectivePass()])
+    assert not _errors(report), report.format_text()
+
+
+# -- the priced artifact + fleet twins ---------------------------------------
+
+
+def test_collective_cost_artifact_over_fleet_twin(mesh8):
+    """The 2x4 fleet twin prices a real ICI/DCN program: artifact carries
+    the mesh attribution (outer axis DCN), per-program modeled seconds,
+    and a DCN share that dominates the ICI share (the 18x beta gap)."""
+    job = models_mod.build_model("wordcount_fleet2")
+    report = analysis.analyze_job(job, "wordcount_fleet2",
+                                  passes=[CollectivePass()])
+    art = report.artifacts["wordcount_fleet2"]["collective_cost"]
+    assert art["mesh"]["label"] == "2dx4i"
+    assert art["mesh"]["processes"] == 2 and art["mesh"]["devices"] == 8
+    assert [a["level"] for a in art["mesh"]["axes"]] == ["dcn", "ici"]
+    assert art["modeled_total_s"] > 0 and art["total_bytes"] > 0
+    per_level: dict = {}
+    for prog in art["programs"].values():
+        for e in prog["collectives"]:
+            for pa in e["per_axis"]:
+                per_level[pa["level"]] = \
+                    per_level.get(pa["level"], 0.0) + pa["seconds"]
+    assert per_level.get("dcn", 0.0) > per_level.get("ici", 0.0)
+
+
+def test_hbm_cost_artifact_surfaces_collective_family(mesh8):
+    """The satellite marker: the hbm-cost artifact reports the collective
+    byte family with priced=False alone, flipped priced=True (with
+    modeled seconds) once the collective-cost pass runs after it."""
+    job = models_mod.build_model("wordcount")
+    report = analysis.analyze_job(job, "wordcount", mesh=mesh8,
+                                  passes=[CostPass()])
+    coll = report.artifacts["wordcount"]["cost"]["collective"]
+    assert coll["priced"] is False and coll["total_bytes"] > 0
+    report = analysis.analyze_job(models_mod.build_model("wordcount"),
+                                  "wordcount", mesh=mesh8,
+                                  passes=[CostPass(), CollectivePass()])
+    coll = report.artifacts["wordcount"]["cost"]["collective"]
+    assert coll["priced"] is True
+    assert coll["priced_by"] == "collective-cost"
+    assert coll["modeled_s"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_twins_clean_under_full_pipeline():
+    """Both fleet registry twins (2x4 tree, 8x1 keyrange) carry zero
+    error findings under the full default pipeline — the all-models gate
+    extension the ISSUE requires, scoped to the new twins so the fast
+    tier doesn't re-sweep the whole zoo (tier-1's --all-models run
+    covers that)."""
+    for name in ("wordcount_fleet2", "wordcount_fleet8"):
+        job = models_mod.build_model(name)
+        report = analysis.analyze_job(job, model=name)
+        assert not report.errors, report.format_text()
+        art = report.artifacts[name]["collective_cost"]
+        assert art["mesh"]["label"] == ("2dx4i" if name.endswith("2")
+                                        else "8d")

@@ -111,6 +111,35 @@ def _geom_env(profile_path: str, env: dict, log) -> dict | None:
             else json.dumps(geom), "BENCH_TRACE": "1"}
 
 
+def _redplan_env(profile_path: str, env: dict, log) -> dict | None:
+    """Map the freshest redplan winner onto bench's BENCH_MERGE_STRATEGY
+    knob (ISSUE 16): the planned row measures exactly the strategy the
+    static link model ranked top, through the same harness as every
+    other row.  None (with a logged reason) when the plan step left no
+    usable profile."""
+    import json
+
+    try:
+        with open(profile_path) as f:
+            profiles = json.load(f).get("profiles", {})
+    except (OSError, ValueError) as e:
+        log(f"redplan rows skipped: no redplan profile ({e!r})")
+        return None
+    planned = {k: v for k, v in profiles.items() if "-redplan/" in k}
+    if not planned:
+        log(f"redplan rows skipped: no redplan profile in {profile_path}")
+        return None
+    key, entry = max(planned.items(),
+                     key=lambda kv: kv[1].get("recorded_at") or "")
+    strategy = (entry.get("config") or {}).get("merge_strategy")
+    if strategy not in ("tree", "gather", "keyrange"):
+        log(f"redplan rows skipped: unusable winner {strategy!r} [{key}]")
+        return None
+    log(f"redplan winner [{key}]: {strategy} "
+        f"(modeled {entry.get('modeled_s')}s)")
+    return {**env, "BENCH_MERGE_STRATEGY": strategy, "BENCH_TRACE": "1"}
+
+
 def _tuned_env(profile_path: str, env: dict, log) -> dict | None:
     """Map the freshest zipf autotune winner onto bench's A/B knobs
     (ISSUE 10): the tuned row measures exactly the searched config
@@ -271,6 +300,18 @@ def main() -> int:
                                      "--out", args.out + ".geom.json",
                                      "--keep-ledgers",
                                      args.out + ".geom-ledgers"], env),
+                # ISSUE 16 reduction-strategy plan: the jax-free link-model
+                # ranking at this window's single-host shape + the bench
+                # table capacity, winner to the .redplan.json profile the
+                # planned/scatter A/B rows below read.  Static (no device
+                # time spent planning); the A/B rows are the measured
+                # falsification of the model's ranking.
+                ("redplan-zipf", [sys.executable, "tools/redplan.py",
+                                  "--processes", "1",
+                                  "--local-devices", "4",
+                                  "--capacity", str(1 << 18),
+                                  "--incumbent", "tree",
+                                  "--out", args.out + ".redplan.json"], env),
                 # Regression A/B rows: the previous default (sort3) and the
                 # uncompacted path.  segmin's stream-sized associative_scan
                 # wedges the chip (3 observations, BENCHMARKS.md round 4) —
@@ -369,6 +410,36 @@ def main() -> int:
                         args.out, "bench-zipf-geom-default",
                         [sys.executable, "bench.py"],
                         {**env, "BENCH_TRACE": "1"}, 1800)
+                    continue
+                if name == "redplan-zipf":
+                    # Stale-profile discipline (the autotune-zipf rule).
+                    try:
+                        os.remove(args.out + ".redplan.json")
+                    except OSError:
+                        pass
+                    results[name] = run_step(args.out, name, cmd, e, 300)
+                    if not results[name]:
+                        log(args.out, "redplan rows skipped: redplan-zipf "
+                                      "step failed or was abandoned")
+                        continue
+                    # ISSUE 16 planned-vs-scatter A/B, back-to-back for
+                    # temporal adjacency: the link model's winner against
+                    # the keyrange all-to-all alternative it priced.
+                    # Both rows are A/B evidence (LAST_GOOD refuses
+                    # BENCH_MERGE_STRATEGY).
+                    planned = _redplan_env(args.out + ".redplan.json", env,
+                                           lambda m: log(args.out, m))
+                    if planned is None:
+                        continue
+                    results["bench-zipf-planned"] = run_step(
+                        args.out, "bench-zipf-planned",
+                        [sys.executable, "bench.py"],
+                        {**planned, "BENCH_STREAMED": "0"}, 1800)
+                    results["bench-zipf-scatter"] = run_step(
+                        args.out, "bench-zipf-scatter",
+                        [sys.executable, "bench.py"],
+                        {**ab, "BENCH_MERGE_STRATEGY": "keyrange",
+                         "BENCH_TRACE": "1"}, 1800)
                     continue
                 if name == "autotune-zipf":
                     # A stale profile from an earlier session at the same

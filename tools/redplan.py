@@ -1,0 +1,540 @@
+#!/usr/bin/env python3
+"""Static reduction-strategy planner over the mesh link model (ISSUE 16).
+
+The merge strategy (``Engine(merge_strategy=...)``: tree / gather /
+keyrange) has been a hand-picked knob since the collectives landed.
+This driver makes it a PLANNED one — the geomsearch discipline applied
+to the reduction seam:
+
+1. **Enumerate + price + rank** (default; jax-free): every feasible
+   reduction strategy for a fleet shape (``--processes`` x
+   ``--local-devices``, ``--capacity`` table rows), priced through the
+   alpha-beta link hierarchy in ``mapreduce_tpu/analysis/meshcost.py``
+   (ICI within a host, DCN across — rates from the checked-in
+   ``analysis/baselines/measured_link_rates.json``), printed as one
+   ranked JSON artifact.  ``--ledger`` seeds the plan from a real run
+   instead: topology + incumbent strategy from its ``run_start``,
+   measured key distribution (``top_mass`` derates keyrange past the
+   skew-hot threshold, ``table_occupancy`` feeds the budget-spill
+   check) via ``obs/history.resolve_prior``, and the PR-13
+   ``fleet_bottleneck`` verdict attached so a straggler-bound fleet is
+   never told to chase collective strategy first.
+2. ``--gate``: certify each ranked strategy through the graphcheck
+   pipeline over a fleet-twin WordCountJob (``analysis_fleet`` +
+   ``analysis_merge_strategy`` — the registry-twin mechanism), the
+   collective-cost pass pricing the very program the strategy builds.
+   Traces on the host; no device.
+3. ``--check``: modeled-vs-measured honesty — the fleet ledger's
+   measured collective seconds (``obs/fleet.fleet_view``) against the
+   model's price for the SAME strategy/topology/capacity, flagged (and
+   exit 1) when they disagree by more than ``CHECK_RATIO``x in either
+   direction.  A flagged check means the link-rate fixture does not
+   describe the hardware the ledger ran on (the checked-in CPU fixture
+   flags by construction — that IS the mechanism proof the selftest
+   pins).
+
+``--out tuned.json`` writes the winner as a ``tuned.json`` profile
+(key ``wordcount-redplan/static/<mesh>-cap<capacity>``) next to the
+autotune/geomsearch profiles, so a launcher can warm-start
+``merge_strategy`` the way ``--geometry auto`` warm-starts geometry.
+
+Usage::
+
+    python tools/redplan.py --processes 2 --local-devices 4 \
+        --capacity 32768 --top-mass 0.3
+    python tools/redplan.py --ledger runs/fleet.jsonl      # measured prior
+    python tools/redplan.py --gate                         # graphcheck gate
+    python tools/redplan.py --check --ledger runs/fleet.jsonl
+    python tools/redplan.py --selftest                     # jax-free
+
+``--selftest`` (wired into ``tools/tier1.sh`` and ``tools/smoke.sh``
+alongside the geomsearch/fleet/chaos selftests) asserts the jax-free
+half against hand arithmetic: the ring-vs-tree crossover closed form
+(``M* = 8 alpha beta`` at D=4 — 3.6 MB on the measured ICI rates), the
+planner's ranking at the fixture shapes, keyrange's skew derating and
+budget-row formula (pinned to ``key_range_merge``'s docstring
+arithmetic), and the whole ledger path over the checked-in Zipf fleet
+fixture: prior resolution, the straggler-bound verdict riding the
+artifact, the incumbent tree strategy ranked top, and the --check flag
+firing on the (deliberately disagreeing) fixture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+FLEET_FIXTURE = os.path.join(FIXTURES, "redplan_fleet.jsonl")
+
+#: Modeled-vs-measured disagreement past which --check flags (either
+#: direction): the model is a congestion-free bound, so 2x headroom is
+#: honest slack; beyond it the link-rate fixture and the hardware the
+#: ledger ran on are different machines.
+CHECK_RATIO = 2.0
+
+
+def _load_by_path(modname: str, relpath: str):
+    """Import a repo module WITHOUT executing its package __init__ (which
+    pulls jax): reuse the already-imported package module when present
+    (pytest, --gate), else load by file path under a private name —
+    registered in sys.modules BEFORE exec (dataclass creation resolves
+    the defining module through sys.modules)."""
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    path = os.path.join(REPO, *relpath.split("/"))
+    spec = importlib.util.spec_from_file_location(
+        "_redplan_" + modname.rsplit(".", 1)[-1], path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_meshcost():
+    return _load_by_path("mapreduce_tpu.analysis.meshcost",
+                         "mapreduce_tpu/analysis/meshcost.py")
+
+
+def _load_fleet():
+    return _load_by_path("mapreduce_tpu.obs.fleet",
+                         "mapreduce_tpu/obs/fleet.py")
+
+
+def _load_history():
+    return _load_by_path("mapreduce_tpu.obs.history",
+                         "mapreduce_tpu/obs/history.py")
+
+
+# -- the measured prior: one fleet ledger -> planner inputs ------------------
+
+def ledger_prior(ledger_path: str) -> dict:
+    """A fleet ledger (sharded ``<path>.h<p>.jsonl`` or single-file) ->
+    the planner's measured inputs: topology + incumbent strategy from
+    ``run_start``, key distribution from the latest ``data`` record
+    (``obs/history.resolve_prior`` — the ONE prior-run read), measured
+    collective seconds + the ``fleet_bottleneck`` verdict from
+    ``obs/fleet.fleet_view``."""
+    fleet = _load_fleet()
+    history = _load_history()
+    paths = fleet.shard_paths(ledger_path)
+    if paths:
+        by_host = fleet.load_shards(paths[h] for h in sorted(paths))
+    elif os.path.exists(ledger_path):
+        by_host = {0: fleet.read_jsonl(ledger_path)}
+    else:
+        raise FileNotFoundError(
+            f"no ledger at {ledger_path} (and no {ledger_path}.h*.jsonl "
+            "shards next to it)")
+    merged = [r for h in sorted(by_host) for r in by_host[h]]
+    prior = history.resolve_prior(records=merged)
+    start = next((r for r in merged if r.get("kind") == "run_start"), {})
+    view = fleet.fleet_view(by_host) or {}
+    data = prior.get("data_record") or {}
+    bottleneck = view.get("fleet_bottleneck") or {}
+    collective = view.get("collective") or {}
+    return {
+        "ledger": ledger_path,
+        "run_id": start.get("run_id"),
+        "processes": int(start.get("processes", len(by_host) or 1)),
+        "local_devices": int(start.get("local_devices", 1)),
+        "incumbent": start.get("merge_strategy"),
+        "capacity": data.get("capacity"),
+        "top_mass": data.get("top_mass"),
+        "table_occupancy": data.get("table_occupancy"),
+        "combiner_prior": prior.get("combiner"),
+        "measured_collective_s": collective.get("mean_s"),
+        "fleet_verdict": bottleneck.get("verdict"),
+        "fleet_bottleneck": bottleneck,
+    }
+
+
+def build_plan(args, mc) -> dict:
+    """CLI args (+ optional ledger prior) -> the ranked plan artifact.
+    Explicit flags win over the ledger; the ledger fills the gaps."""
+    prior = ledger_prior(args.ledger) if args.ledger else {}
+
+    def pick(flag, key, default=None):
+        return flag if flag is not None else prior.get(key, default) \
+            if prior.get(key) is not None else default
+
+    processes = int(pick(args.processes, "processes", 2))
+    local_devices = int(pick(args.local_devices, "local_devices", 4))
+    capacity = int(pick(args.capacity, "capacity", 8192))
+    art = mc.plan(processes, local_devices, capacity,
+                  top_mass=pick(args.top_mass, "top_mass"),
+                  table_occupancy=pick(args.occupancy, "table_occupancy"),
+                  incumbent=pick(args.incumbent, "incumbent"))
+    if prior:
+        art["prior"] = {k: prior[k] for k in
+                        ("ledger", "run_id", "incumbent", "top_mass",
+                         "table_occupancy", "combiner_prior",
+                         "measured_collective_s", "fleet_verdict")}
+        verdict = prior.get("fleet_verdict")
+        if verdict and verdict != "collective-bound":
+            art["note"] = (
+                f"fleet verdict is {verdict!r}: the measured bottleneck is "
+                "NOT the finish collective — the ranking below is the "
+                "right strategy for the reduce seam, but fix the "
+                "bottleneck the verdict names first")
+    return art
+
+
+# -- --check: modeled vs measured over a real fleet ledger -------------------
+
+def check_disagreement(measured_s, modeled_s, ratio=CHECK_RATIO) -> dict:
+    """The one --check rule, pure: measured/modeled outside
+    [1/ratio, ratio] flags.  Kept separate so the selftest pins the
+    mechanics without a ledger."""
+    if not measured_s or not modeled_s or modeled_s <= 0:
+        return {"measured_s": measured_s, "modeled_s": modeled_s,
+                "ratio": None, "flag": False,
+                "why": "no measured collective seconds to compare"}
+    r = measured_s / modeled_s
+    return {"measured_s": round(measured_s, 9),
+            "modeled_s": round(modeled_s, 9),
+            "ratio": round(r, 3), "flag": r > ratio or r < 1.0 / ratio}
+
+
+def run_check(args, mc) -> int:
+    if not args.ledger:
+        print("redplan --check needs --ledger (measured collective seconds "
+              "come from a fleet ledger)", file=sys.stderr)
+        return 2
+    prior = ledger_prior(args.ledger)
+    strategy = prior.get("incumbent")
+    if strategy not in mc.STRATEGIES:
+        print(f"redplan --check: ledger merge_strategy {strategy!r} has no "
+              "model; pricing the tree schedule instead", file=sys.stderr)
+        strategy = "tree"
+    rates = mc.load_link_rates()
+    capacity = int(prior.get("capacity") or 8192)
+    processes = int(prior.get("processes") or 1)
+    local_devices = int(prior.get("local_devices") or 1)
+    mesh = mc.MeshSpec.fleet(processes, local_devices) if processes > 1 \
+        else mc.MeshSpec.single_host(local_devices)
+    priced = mc.price_strategy(strategy, mc.table_bytes(capacity), mesh,
+                               rates["levels"],
+                               slack=rates["keyrange_slack"])
+    res = check_disagreement(prior.get("measured_collective_s"),
+                             priced["modeled_s"])
+    art = {"check": res, "strategy": strategy,
+           "mesh": {"processes": processes, "local_devices": local_devices,
+                    "label": mesh.label()},
+           "capacity": capacity, "run_id": prior.get("run_id"),
+           "fleet_verdict": prior.get("fleet_verdict"),
+           "check_ratio": CHECK_RATIO}
+    if res["flag"]:
+        art["why"] = (
+            f"measured finish collective ({res['measured_s']}s mean) is "
+            f"{res['ratio']}x the alpha-beta model ({res['modeled_s']}s) "
+            f"for {strategy!r} over {mesh.label()}: "
+            "analysis/baselines/measured_link_rates.json does not describe "
+            "the links this ledger ran on — remeasure the rates (or stop "
+            "trusting the plan on this hardware)")
+    print(json.dumps(art, indent=1))
+    return 1 if res["flag"] else 0
+
+
+# -- --gate: graphcheck certification of each ranked strategy ----------------
+
+def gate_strategies(art, log) -> list:
+    """Certify each ranked strategy through the graphcheck pipeline over
+    a fleet-twin WordCountJob at the planned topology — the registry-twin
+    mechanism (``analysis_fleet`` + ``analysis_merge_strategy``), so the
+    collective-cost pass prices the very finish program each strategy
+    builds.  The baseline-keyed passes (hbm-cost, fusion-opportunity)
+    stay out — ad-hoc twins have no checked-in baselines (the
+    geomsearch gate discipline).  Returns the zero-error strategies."""
+    from mapreduce_tpu import analysis
+    from mapreduce_tpu.models import ANALYSIS_CONFIG
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    passes = [p for p in analysis.default_pipeline()
+              if p.pass_id not in ("hbm-cost", "fusion-opportunity")]
+    mesh = art["mesh"]
+    kept = []
+    for ranked in art["ranked"]:
+        name = ranked["strategy"]
+        job = WordCountJob(ANALYSIS_CONFIG)
+        job.analysis_fleet = {"processes": mesh["processes"],
+                              "local_devices": mesh["local_devices"]}
+        job.analysis_merge_strategy = name
+        report = analysis.analyze_job(job, f"<redplan:{name}>",
+                                      passes=passes)
+        if report.errors:
+            log(f"gate REJECTED {name} over {mesh['label']}:\n"
+                + report.format_text("error"))
+            continue
+        log(f"gate ok: {name} over {mesh['label']} "
+            f"(modeled {ranked['modeled_s'] * 1e6:.1f}us)")
+        kept.append(name)
+    return kept
+
+
+# -- profile output ----------------------------------------------------------
+
+def write_profile(art, out_path: str, log) -> str:
+    """The planner's winner as a tuned.json profile (autotune's merge-
+    one-key writer), so ``merge_strategy`` can warm-start from the plan
+    like geometry warm-starts from geomsearch."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import autotune
+    finally:
+        sys.path.pop(0)
+    key = (f"wordcount-redplan/static/{art['mesh']['label']}"
+           f"-cap{art['capacity']}")
+    entry = {"config": {"merge_strategy": art["top"]},
+             "modeled_s": art["ranked"][0]["modeled_s"],
+             "stopped": "planned",
+             "mesh": art["mesh"],
+             "ranked": [{"strategy": r["strategy"],
+                         "modeled_s": r["modeled_s"]}
+                        for r in art["ranked"]],
+             "fleet_verdict": (art.get("prior") or {}).get("fleet_verdict"),
+             "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())}
+    autotune.write_profile(out_path, key, entry)
+    log(f"winner {art['top']} (modeled "
+        f"{art['ranked'][0]['modeled_s'] * 1e6:.1f}us) -> {out_path} "
+        f"[{key}]")
+    return key
+
+
+# -- selftest (jax-free) -----------------------------------------------------
+
+def selftest() -> int:
+    """The jax-free planner end to end, against hand arithmetic and the
+    checked-in Zipf fleet fixture — the tier-1/smoke gate."""
+    import math
+
+    had_jax = "jax" in sys.modules
+    mc = _load_meshcost()
+
+    # The measured link fixture: three levels, strictly slower outward.
+    rates = mc.load_link_rates()
+    levels, slack = rates["levels"], rates["keyrange_slack"]
+    assert set(levels) == {"hbm", "ici", "dcn"}, sorted(levels)
+    assert levels["hbm"].beta_bps > levels["ici"].beta_bps \
+        > levels["dcn"].beta_bps
+    assert levels["hbm"].alpha_s < levels["ici"].alpha_s \
+        < levels["dcn"].alpha_s
+    assert slack == 2.0, slack
+
+    # Ring-vs-tree crossover, closed form vs hand arithmetic: at D=4 the
+    # formula reduces to M* = alpha*beta*(6-2)/(2-3/2) = 8*alpha*beta —
+    # 3.6 MB on the measured ICI rates (alpha 10us, beta 45 GB/s) — and
+    # the two schedules price EQUAL there: ring = 6a + (3/2)M/b,
+    # tree = 2a + 2M/b, both 180us.
+    ici = levels["ici"]
+    mstar = mc.ring_tree_crossover_bytes(4, ici)
+    assert math.isclose(mstar, 8 * ici.alpha_s * ici.beta_bps), mstar
+    assert math.isclose(mstar, 3.6e6), mstar
+    ring_at = mc.allreduce_ring(mstar, 4, ici)
+    tree_at = mc.allreduce_tree(mstar, 4, ici)
+    assert math.isclose(ring_at, tree_at), (ring_at, tree_at)
+    assert math.isclose(ring_at, 1.8e-4), ring_at
+    # Below M* the butterfly's 2 rounds beat the ring's 6; above, the
+    # ring's 1.5x byte factor beats the butterfly's 2x.
+    assert mc.allreduce_tree(mstar / 4, 4, ici) \
+        < mc.allreduce_ring(mstar / 4, 4, ici)
+    assert mc.allreduce_ring(4 * mstar, 4, ici) \
+        < mc.allreduce_tree(4 * mstar, 4, ici)
+    assert mc.ring_tree_crossover_bytes(2, ici) == math.inf
+
+    # Schedule units at D=2: one round of M for tree AND gather (they
+    # coincide — the planner's ranking there is byte-for-byte honest).
+    m = mc.table_bytes(8192)
+    assert m == 7 * 4 * 8192 == 229376
+    assert math.isclose(mc.allreduce_tree(m, 2, ici),
+                        mc.allgather(m, 2, ici))
+
+    # keyrange budget rows == key_range_merge's docstring formula
+    # (B = min(cap, ceil(s*cap/D) + 8 + 4*ceil(log2 D))), pinned so the
+    # planner's spill arithmetic can never drift from the runtime.
+    for cap, d in ((8192, 8), (32768, 8), (512, 4), (8192, 1)):
+        want = cap if d <= 1 else min(
+            cap, -(-int(slack * cap) // d) + 8 + 4 * (d - 1).bit_length())
+        got = mc.keyrange_budget_rows(cap, d, slack)
+        assert got == want, (cap, d, got, want)
+    # ceil(2*8192/8) + 8 + 4*bitlen(7) = 2048 + 8 + 12 by hand.
+    assert mc.keyrange_budget_rows(8192, 8, 2.0) == 2068
+
+    # Planner ranking at 2x4 / cap 8192 (229 KB payload): latency-bound,
+    # so gather's single round per level edges out tree and keyrange
+    # pays double DCN traffic — the hand-priced table.
+    p = mc.plan(2, 4, 8192)
+    order = [r["strategy"] for r in p["ranked"]]
+    assert order == ["gather", "tree", "keyrange"], order
+    by = {r["strategy"]: r["modeled_s"] for r in p["ranked"]}
+    assert math.isclose(by["gather"], 0.000217042, rel_tol=1e-6), by
+    assert math.isclose(by["tree"], 0.000221945, rel_tol=1e-6), by
+    assert math.isclose(by["keyrange"], 0.000567002, rel_tol=1e-6), by
+    assert p["mesh"]["label"] == "2dx4i" and p["payload_bytes"] == 229376
+
+    # At 4x the capacity the tree's log2(D) rounds beat gather's (D-1)
+    # bytes on the ICI level (crossover arithmetic again), and measured
+    # Zipf skew (top_mass 0.3 > the 0.05 hot threshold) derates keyrange
+    # by exactly 1.3x.
+    p = mc.plan(2, 4, 32768, top_mass=0.3, table_occupancy=0.85,
+                incumbent="tree")
+    order = [r["strategy"] for r in p["ranked"]]
+    assert order == ["tree", "gather", "keyrange"], order
+    by = {r["strategy"]: r for r in p["ranked"]}
+    assert math.isclose(by["tree"]["modeled_s"], 0.00052778,
+                        rel_tol=1e-6), by["tree"]
+    assert p["incumbent_is_top"] is True
+    kr = by["keyrange"]
+    base = mc.keyrange(mc.table_bytes(32768), 8, levels["dcn"], slack=slack)
+    assert math.isclose(kr["modeled_s"], base * 1.3, rel_tol=1e-6), kr
+    assert any("skew derating" in n for n in kr["notes"]), kr["notes"]
+    # No keyrange hook -> the strategy is skipped, never silently priced.
+    p8 = mc.plan(8, 1, 8192, has_keyrange_hook=False)
+    assert [s["strategy"] for s in p8["skipped"]] == ["keyrange"]
+    assert all(r["strategy"] != "keyrange" for r in p8["ranked"])
+
+    # Strategy descriptors name the exact runtime builders (the pytest
+    # suite asserts the full bijection against parallel/collectives.py;
+    # here just the jax-free half).
+    assert set(mc.STRATEGIES) == {"tree", "gather", "keyrange"}
+    assert mc.STRATEGIES["tree"].builder.endswith("collectives.tree_merge")
+    assert mc.STRATEGIES["tree"].power_of_two_only
+    assert mc.STRATEGIES["keyrange"].needs_keyrange_hook
+
+    # The whole ledger path over the checked-in Zipf fleet fixture:
+    # prior resolution (topology 2x4, cap 32768, top_mass 0.30 -> the
+    # hot-cache combiner prior), the PR-13 straggler-bound verdict, and
+    # the plan built FROM it — incumbent tree ranked top, which is
+    # exactly what the verdict implies: the fleet's bottleneck is the
+    # 2.0s host skew, not the 0.3s collective, so the planner must not
+    # propose a strategy migration.
+    prior = ledger_prior(FLEET_FIXTURE)
+    assert prior["processes"] == 2 and prior["local_devices"] == 4
+    assert prior["capacity"] == 32768 and prior["incumbent"] == "tree"
+    assert math.isclose(prior["top_mass"], 0.3)
+    assert prior["combiner_prior"] == "hot-cache"
+    assert prior["fleet_verdict"] == "straggler-bound"
+    assert math.isclose(prior["measured_collective_s"], 0.3)
+    assert math.isclose(prior["fleet_bottleneck"]["straggler_s"], 2.0)
+    args = argparse.Namespace(ledger=FLEET_FIXTURE, processes=None,
+                              local_devices=None, capacity=None,
+                              top_mass=None, occupancy=None, incumbent=None)
+    art = build_plan(args, mc)
+    assert art["top"] == "tree" and art["incumbent_is_top"] is True
+    assert art["prior"]["fleet_verdict"] == "straggler-bound"
+    assert "fix the bottleneck the verdict names first" in art["note"]
+    assert art["ranked"][0]["modeled_s"] \
+        < prior["fleet_bottleneck"]["straggler_s"]
+    json.dumps(art)  # the artifact is JSON-clean
+
+    # --check mechanics: the pure rule both ways, then the fixture —
+    # which MUST flag (CPU-synthesized 0.3s vs the 528us TPU-link bound
+    # is a ~568x disagreement: the mechanism proof that a wrong rates
+    # fixture cannot slip through quietly).
+    assert not check_disagreement(6e-4, 5.28e-4)["flag"]
+    assert check_disagreement(3e-4, 5.28e-4)["flag"] is False
+    assert check_disagreement(0.3, 5.28e-4)["flag"] is True
+    assert check_disagreement(1e-4, 5.28e-4)["flag"] is True  # too FAST too
+    assert check_disagreement(None, 5.28e-4)["flag"] is False
+    res = check_disagreement(prior["measured_collective_s"],
+                             mc.price_strategy(
+                                 "tree", mc.table_bytes(32768),
+                                 mc.MeshSpec.fleet(2, 4), levels,
+                                 slack=slack)["modeled_s"])
+    assert res["flag"] and res["ratio"] > 500, res
+
+    # Profile write round-trip (tuned.json shape autotune/geometry read).
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = os.path.join(d, "tuned.json")
+        key = write_profile(art, out, lambda m: None)
+        with open(out, encoding="utf-8") as f:
+            prof = json.load(f)["profiles"][key]
+        assert prof["config"] == {"merge_strategy": "tree"}
+        assert prof["stopped"] == "planned"
+        assert prof["fleet_verdict"] == "straggler-bound"
+
+    assert had_jax or "jax" not in sys.modules, \
+        "selftest must stay jax-free"
+    print("redplan selftest ok (crossover M*=3.6MB at D=4 ICI with "
+          "ring==tree==180us, rankings 8192->gather / 32768->tree, "
+          "keyrange skew derating 1.3x + budget-row parity, fixture "
+          "prior straggler-bound with incumbent tree on top, --check "
+          f"flags the {res['ratio']}x fixture disagreement)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static reduction-strategy planner: jax-free ranked "
+                    "plan over the ICI/DCN link model, graphcheck gate, "
+                    "modeled-vs-measured ledger check")
+    ap.add_argument("--processes", type=int, default=None,
+                    help="fleet processes/hosts (outer DCN axis; default 2 "
+                         "or the ledger's)")
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="devices per process (inner ICI axis; default 4 "
+                         "or the ledger's)")
+    ap.add_argument("--capacity", type=int, default=None,
+                    help="CountTable capacity in rows (default 8192 or the "
+                         "ledger's) — sets the 7-plane payload")
+    ap.add_argument("--top-mass", type=float, default=None,
+                    help="measured top-key mass (derates keyrange past "
+                         "0.05; default: the ledger's data record)")
+    ap.add_argument("--occupancy", type=float, default=None,
+                    help="measured table occupancy for the keyrange "
+                         "budget-spill check (default: the ledger's)")
+    ap.add_argument("--incumbent", default=None,
+                    help="strategy currently deployed (ranked artifact "
+                         "reports whether it stays on top)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="fleet ledger (sharded <path>.h<p>.jsonl or "
+                         "single-file): topology/incumbent/key "
+                         "distribution prior + fleet verdict")
+    ap.add_argument("--gate", action="store_true",
+                    help="certify each ranked strategy through the "
+                         "graphcheck pipeline over a fleet-twin job "
+                         "(host tracing; no device)")
+    ap.add_argument("--check", action="store_true",
+                    help="modeled vs measured collective seconds over "
+                         "--ledger; exit 1 past the 2x disagreement gate")
+    ap.add_argument("--out", default=None, metavar="TUNED_JSON",
+                    help="also write the winner as a tuned.json profile "
+                         "(wordcount-redplan/static/<mesh>-cap<capacity>)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the jax-free selftest and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    mc = _load_meshcost()
+    if args.check:
+        return run_check(args, mc)
+    art = build_plan(args, mc)
+
+    def log(msg: str) -> None:
+        print(f"[redplan] {msg}", file=sys.stderr, flush=True)
+
+    if args.gate:
+        gated = gate_strategies(art, log)
+        art["gated"] = gated
+        print(json.dumps(art, indent=1))
+        return 0 if len(gated) == len(art["ranked"]) else 1
+    if args.out:
+        art["profile_key"] = write_profile(art, args.out, log)
+    print(json.dumps(art, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,4 +22,5 @@ timeout -k 5 60 python tools/obswatch.py --selftest || { echo "SMOKE: obswatch s
 timeout -k 5 60 python tools/autotune.py --selftest || { echo "SMOKE: autotune selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/geomsearch.py --selftest || { echo "SMOKE: geomsearch selftest FAILED"; exit 1; }
 timeout -k 5 60 python tools/chaos.py --selftest || { echo "SMOKE: chaos selftest FAILED"; exit 1; }
+timeout -k 5 60 python tools/redplan.py --selftest || { echo "SMOKE: redplan selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_smoke.log; timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'smoke and not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_smoke.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_smoke.log | tr -cd . | wc -c); exit $rc

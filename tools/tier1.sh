@@ -55,4 +55,10 @@ timeout -k 5 60 python tools/geomsearch.py --selftest || { echo "TIER1: geomsear
 # spec round-trip, and the replay-from-ledger contract over the
 # checked-in chaotic fixture run — jax-free, seconds.
 timeout -k 5 60 python tools/chaos.py --selftest || { echo "TIER1: chaos selftest FAILED"; exit 1; }
+# Reduction-strategy planner gate (ISSUE 16): the ring-vs-tree crossover
+# closed form (M* = 8*alpha*beta at D=4) against the measured link
+# rates, the planner rankings + keyrange skew derating, and the ledger
+# path over the Zipf fleet fixture (straggler-bound verdict, incumbent
+# on top, modeled-vs-measured --check flag) — jax-free, seconds.
+timeout -k 5 60 python tools/redplan.py --selftest || { echo "TIER1: redplan selftest FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
