@@ -33,9 +33,17 @@ BENCH_SUPERSTEP (override chunks per dispatch; default: all resident),
 BENCH_BASELINE_MB (CPU baseline slice, default 16), BENCH_SORT_MODE /
 BENCH_SORT_IMPL / BENCH_MAP_IMPL / BENCH_COMBINER / BENCH_GEOMETRY /
 BENCH_MERGE_EVERY /
-BENCH_MERGE_STRATEGY (tree / gather / keyrange — the reduction seam the
-static planner `tools/redplan.py` ranks; keyrange is the planner's
-skew-sensitive alternative) /
+BENCH_MERGE_STRATEGY (tree / gather / keyrange / hier-kr-tree /
+hier-tree-tree / auto — the reduction seam the static planner
+`tools/redplan.py` ranks; keyrange is the planner's skew-sensitive
+alternative, the hier-* 2-D programs need a fleet mesh, and 'auto'
+warm-starts from the planner's freshest tuned.json profile via
+resolve_prior — the resolved strategy is stamped, never 'auto';
+BENCH_MERGE_PROFILE overrides the profile path) /
+BENCH_MERGE_OVERLAP (1 = drain local tables into a resident accumulator
+at window boundaries on the STREAMED pass — async partial collectives
+overlapped with the map stream, bit-identical results, op='partial'
+ledger records; ISSUE 20 leg 2) /
 BENCH_COMPACT_SLOTS /
 BENCH_INFLIGHT / BENCH_PREFETCH_DEPTH (A/B knobs — measurement-altering,
 so BENCH_LAST_GOOD refuses them; BENCH_INFLIGHT=1 is the serialized
@@ -566,6 +574,28 @@ def main() -> int:
     geom_env = os.environ.get("BENCH_GEOMETRY") or None
     if geom_env and geom_env.lstrip().startswith("{"):
         geom_env = json.loads(geom_env)
+    # BENCH_MERGE_STRATEGY=auto warm-starts from the static reduction
+    # planner's freshest tuned.json profile (tools/redplan.py --out),
+    # through the run-history warehouse's resolve_prior — the RESOLVED
+    # strategy reaches the Engine, the streamed config and the run_start
+    # stamp (never the literal 'auto'); no matching profile falls back
+    # LOUDLY to tree.  The bench mesh is 1-D, so only single-axis
+    # strategies are eligible — a hier-* winner planned over a 2-D fleet
+    # mesh is skipped, not mis-run.
+    merge_strategy = os.environ.get("BENCH_MERGE_STRATEGY", "tree")
+    if merge_strategy == "auto":
+        from mapreduce_tpu.config import MERGE_STRATEGIES
+        from mapreduce_tpu.obs import history
+
+        prior = history.resolve_prior(
+            profile_path=os.environ.get("BENCH_MERGE_PROFILE", "tuned.json"),
+            merge_allowed=tuple(s for s in MERGE_STRATEGIES
+                                if not s.startswith("hier-")))
+        merge_strategy = prior["merge_strategy"]
+        _log("merge-strategy: auto -> " + merge_strategy
+             + ("" if prior["merge_strategy_profile"]
+                else " (no redplan profile; tree)"), wall0)
+    merge_overlap = os.environ.get("BENCH_MERGE_OVERLAP", "0") == "1"
     cfg = Config(chunk_bytes=chunk_mb << 20, table_capacity=1 << 18,
                  batch_unique_capacity=1 << 16,
                  sort_mode=os.environ.get("BENCH_SORT_MODE",
@@ -578,14 +608,13 @@ def main() -> int:
                                          Config.combiner),
                  geometry=geom_env,
                  merge_every=int(os.environ.get("BENCH_MERGE_EVERY", "1")),
+                 merge_strategy=merge_strategy,
                  compact_slots=(int(os.environ["BENCH_COMPACT_SLOTS"])
                                 if "BENCH_COMPACT_SLOTS" in os.environ
                                 else None))
     mesh = data_mesh()
     n_dev = mesh.devices.size
-    engine = Engine(WordCountJob(cfg), mesh,
-                    merge_strategy=os.environ.get("BENCH_MERGE_STRATEGY",
-                                                  "tree"))
+    engine = Engine(WordCountJob(cfg), mesh, merge_strategy=merge_strategy)
 
     with tempfile.NamedTemporaryFile(dir="/tmp", suffix=".txt", delete=False) as f:
         f.write(corpus)
@@ -706,7 +735,12 @@ def main() -> int:
                         "BENCH_INFLIGHT", str(_Config.inflight_groups))),
                     prefetch_depth=(
                         int(os.environ["BENCH_PREFETCH_DEPTH"])
-                        if os.environ.get("BENCH_PREFETCH_DEPTH") else None))
+                        if os.environ.get("BENCH_PREFETCH_DEPTH") else None),
+                    # BENCH_MERGE_OVERLAP=1: window-boundary partial
+                    # collectives on the streamed pass (ISSUE 20 leg 2;
+                    # bit-identical, measurement-altering like every A/B
+                    # knob, so LAST_GOOD's class gate refuses it).
+                    merge_overlap=merge_overlap)
                 # Warm-up: a short-range run pays the XLA compiles for the
                 # streamed shapes (the persistent compile cache makes the
                 # timed run's identical programs cache hits), so the timed
@@ -770,6 +804,12 @@ def main() -> int:
     # carry the prediction and the measurement in one JSON.
     result["map_impl"] = cfg.map_impl
     result["combiner"] = cfg.resolved_combiner
+    # The reduction placement next to the measurement (ISSUE 20): the
+    # RESOLVED strategy (never 'auto') + whether the streamed pass
+    # overlapped its partial collectives with the map stream.
+    result["merge_strategy"] = merge_strategy
+    if merge_overlap:
+        result["merge_overlap"] = True
     cost = _cost_record(cfg.map_impl, cfg.resolved_combiner)
     if cost is not None:
         result["cost"] = cost

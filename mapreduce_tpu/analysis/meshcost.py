@@ -304,6 +304,26 @@ STRATEGIES = {
                     "owner reduce + all_gather of reduced blocks, over "
                     "the FLATTENED axis (trades the ICI/DCN hierarchy "
                     "for a single scheduled collective)"),
+    # The 2-D placed compositions (ISSUE 20): one strategy per link
+    # level, priced exactly as the runtime composes them (inner axis
+    # first; only feasible on multi-axis meshes — plan() skips them on a
+    # single-host shape with a reason instead of pricing a degenerate).
+    "hier-kr-tree": Strategy(
+        name="hier-kr-tree",
+        builder="mapreduce_tpu.parallel.collectives.hier_kr_tree_merge",
+        power_of_two_only=True,
+        needs_keyrange_hook=True,
+        description="placed 2-D reduction: keyrange on the inner (ICI) "
+                    "axis — budgeted all_to_all + owner reduce over the "
+                    "cheap link — then butterfly tree over the outer "
+                    "(DCN) axes with the already-reduced payload"),
+    "hier-tree-tree": Strategy(
+        name="hier-tree-tree",
+        builder="mapreduce_tpu.parallel.collectives.hier_tree_tree_merge",
+        power_of_two_only=True,
+        description="the named 2-D tree composition: butterfly per "
+                    "level, innermost first (same schedule 'tree' runs "
+                    "on a multi-axis mesh, as an explicit placement)"),
 }
 
 
@@ -317,11 +337,32 @@ def keyrange_budget_rows(capacity: int, d: int, slack: float) -> int:
                -(-int(slack * capacity) // d) + 8 + 4 * (d - 1).bit_length())
 
 
+def _price_tree_leg(ax: MeshAxis, m: float, levels: dict,
+                    notes: list) -> dict:
+    """One butterfly leg over one axis (with tree_merge's documented
+    non-power-of-two gather fallback) — shared by 'tree' and the
+    hierarchical compositions so the legs can never price differently."""
+    link = levels[ax.level]
+    if ax.size & (ax.size - 1):
+        s = allgather(m, ax.size, link)
+        sched = "all-gather (non-power-of-two fallback)"
+        notes.append(f"axis {ax.name!r} (D={ax.size}) is not a "
+                     "power of two: tree_merge falls back to "
+                     "gather there")
+    else:
+        s = allreduce_tree(m, ax.size, link)
+        sched = "butterfly-tree"
+    return {"axis": ax.name, "d": ax.size, "level": ax.level,
+            "schedule": sched, "seconds": s}
+
+
 def price_strategy(name: str, payload_bytes: int, mesh: MeshSpec,
                    levels: dict, slack: float = 2.0) -> dict:
     """Model one strategy end to end over a mesh: per-level schedule
     seconds, innermost-first for the hierarchical strategies (the
-    ``hierarchical_merge`` order), flattened-axis for keyrange."""
+    ``hierarchical_merge`` order), flattened-axis for keyrange, and
+    per-level placement for the hier-* compositions (keyrange priced at
+    the INNER axis's link, tree legs over the outer axes)."""
     strat = STRATEGIES[name]
     per_level = []
     total = 0.0
@@ -335,27 +376,35 @@ def price_strategy(name: str, payload_bytes: int, mesh: MeshSpec,
         per_level.append({"axis": "<flattened>", "d": d, "level": level,
                           "schedule": "keyrange-a2a", "seconds": s})
         total = s
-    else:
+    elif name == "hier-kr-tree":
+        # hier_kr_tree_merge's placement: the budgeted all_to_all round
+        # runs over the innermost (fast-link) axis only, then the
+        # already-reduced payload crosses the outer levels as tree legs.
+        inner = mesh.axes[-1]
+        link = levels[inner.level]
+        s = keyrange(m, inner.size, link, slack=slack)
+        per_level.append({"axis": inner.name, "d": inner.size,
+                          "level": inner.level, "schedule": "keyrange-a2a",
+                          "seconds": s})
+        total = s
+        for ax in reversed(mesh.axes[:-1]):
+            leg = _price_tree_leg(ax, m, levels, notes)
+            per_level.append(leg)
+            total += leg["seconds"]
+    elif name in ("tree", "hier-tree-tree"):
         # hierarchical_merge order: innermost (fast) axis first, so the
         # outer (slow) level moves one already-merged payload per group.
         for ax in reversed(mesh.axes):
+            leg = _price_tree_leg(ax, m, levels, notes)
+            per_level.append(leg)
+            total += leg["seconds"]
+    else:
+        for ax in reversed(mesh.axes):
             link = levels[ax.level]
-            if name == "tree":
-                if ax.size & (ax.size - 1):
-                    s = allgather(m, ax.size, link)
-                    sched = "all-gather (non-power-of-two fallback)"
-                    notes.append(f"axis {ax.name!r} (D={ax.size}) is not a "
-                                 "power of two: tree_merge falls back to "
-                                 "gather there")
-                else:
-                    s = allreduce_tree(m, ax.size, link)
-                    sched = "butterfly-tree"
-            else:
-                s = allgather(m, ax.size, link)
-                sched = "all-gather+fold"
+            s = allgather(m, ax.size, link)
             per_level.append({"axis": ax.name, "d": ax.size,
-                              "level": ax.level, "schedule": sched,
-                              "seconds": s})
+                              "level": ax.level,
+                              "schedule": "all-gather+fold", "seconds": s})
             total += s
     return {"strategy": name, "builder": strat.builder,
             "modeled_s": total, "per_level": per_level, "notes": notes}
@@ -384,20 +433,37 @@ def plan(processes: int, local_devices: int, capacity: int, *,
     payload = table_bytes(capacity)
     ranked = []
     skipped = []
+    decl_order = {name: i for i, name in enumerate(STRATEGIES)}
     for name, strat in STRATEGIES.items():
+        if name.startswith("hier-") and len(mesh.axes) < 2:
+            skipped.append({"strategy": name,
+                            "why": "needs a multi-axis mesh (a single-"
+                                   "host shape has one link level to "
+                                   "place over)"})
+            continue
         if strat.needs_keyrange_hook and not has_keyrange_hook:
             skipped.append({"strategy": name,
                             "why": "job has no keyrange_merge hook"})
             continue
         priced = price_strategy(name, payload, mesh, levels, slack=slack)
-        if name == "keyrange":
-            d = mesh.n_devices
+        if name in ("keyrange", "hier-kr-tree"):
+            # hier-kr-tree's keyrange leg runs over the INNER axis only,
+            # so its budget/derating arithmetic uses that axis's size.
+            d = mesh.n_devices if name == "keyrange" else mesh.axes[-1].size
             budget = keyrange_budget_rows(capacity, d, slack)
             priced["keyrange_budget_rows"] = budget
             if top_mass is not None and top_mass > TOP_MASS_HOT:
-                priced["modeled_s"] *= 1.0 + float(top_mass)
+                if name == "keyrange":
+                    priced["modeled_s"] *= 1.0 + float(top_mass)
+                else:
+                    inner = priced["per_level"][0]
+                    delta = inner["seconds"] * float(top_mass)
+                    inner["seconds"] += delta
+                    priced["modeled_s"] += delta
+                leg = "" if name == "keyrange" \
+                    else " (on the inner keyrange leg)"
                 priced["notes"].append(
-                    f"skew derating x{1 + top_mass:.2f}: measured "
+                    f"skew derating x{1 + top_mass:.2f}{leg}: measured "
                     f"top_mass {top_mass:.2f} > {TOP_MASS_HOT} puts the "
                     "hot key's owner partition on the critical path")
             if table_occupancy is not None and d > 1 \
@@ -411,7 +477,10 @@ def plan(processes: int, local_devices: int, capacity: int, *,
         for lv in priced["per_level"]:
             lv["seconds"] = round(lv["seconds"], 9)
         ranked.append(priced)
-    ranked.sort(key=lambda p: (p["modeled_s"], p["strategy"]))
+    # Ties go to the earlier-declared, simpler strategy (hier-tree-tree
+    # prices identically to tree on every 2-D mesh by construction — the
+    # incumbent must not be displaced by its own composition's alias).
+    ranked.sort(key=lambda p: (p["modeled_s"], decl_order[p["strategy"]]))
     return {
         "mesh": {"processes": int(processes),
                  "local_devices": int(local_devices),

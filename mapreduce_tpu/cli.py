@@ -23,7 +23,8 @@ import json
 import sys
 import time
 
-from mapreduce_tpu.config import Config, PlatformRefusedError
+from mapreduce_tpu.config import (MERGE_STRATEGIES, Config,
+                                  PlatformRefusedError)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -136,12 +137,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "once every K steps (one K-way reduce replaces K "
                         "pairwise merges; word-count family only; kept "
                         "counts identical)")
-    p.add_argument("--merge-strategy", choices=("tree", "gather", "keyrange"),
+    p.add_argument("--merge-strategy",
+                   choices=MERGE_STRATEGIES + ("auto",),
                    default="tree",
                    help="collective global-reduce strategy for streamed "
                         "word-count runs: butterfly tree (log2(D) rounds), "
-                        "all_gather + fold, or key-range all_to_all "
-                        "reduce-scatter (one round; the pod-scale choice)")
+                        "all_gather + fold, key-range all_to_all "
+                        "reduce-scatter (one round; the pod-scale choice), "
+                        "or a hierarchical 2-D program (hier-kr-tree / "
+                        "hier-tree-tree — fleet meshes only; the CLI's 1-D "
+                        "mesh rejects them). 'auto' warm-starts from the "
+                        "static reduction planner's freshest tuned profile "
+                        "(tools/redplan.py --out, read from the "
+                        "--geometry-profile file; no matching profile "
+                        "falls back loudly to tree)")
+    p.add_argument("--merge-overlap", action="store_true",
+                   help="with --stream: drain the local tables into a "
+                        "device-resident merged accumulator at window "
+                        "boundaries (one async partial collective per "
+                        "--inflight retired groups), overlapping "
+                        "interconnect time with map compute; results stay "
+                        "bit-identical and each partial lands as an "
+                        "op='partial' collective ledger record (v10); "
+                        "requires --retry 0")
     p.add_argument("--compact-slots", type=int, default=None, metavar="S",
                    help="slot-compact the pallas kernel's output to S rows "
                         "per 256-byte window (multiple of 8; 0 = off; "
@@ -542,6 +560,22 @@ def main(argv: list[str] | None = None) -> int:
             parser.error("--merge-strategy requires --stream")
         if args.grep is not None or args.sample is not None:
             parser.error("--merge-strategy applies to word-count runs only")
+        if args.merge_strategy.startswith("hier-"):
+            # The hierarchical 2-D programs place legs on named mesh axes;
+            # the CLI drives a 1-D data mesh, so refuse here instead of
+            # surfacing the Engine's multi-axis ValueError mid-run.
+            parser.error(f"--merge-strategy {args.merge_strategy} needs a "
+                         "multi-axis device mesh; the CLI drives a 1-D "
+                         "mesh (2-D programs run via the fleet registry "
+                         "twins / run_job_global)")
+    if args.merge_overlap:
+        if not args.stream:
+            parser.error("--merge-overlap requires --stream")
+        if args.retry:
+            parser.error("--merge-overlap requires --retry 0 (the replay "
+                         "anchor snapshots local state only; an overlapped "
+                         "window has shipped counts the anchor cannot "
+                         "restore)")
     paths = args.input
     try:
         # Probe readability up front (the reference silently succeeds on
@@ -587,6 +621,7 @@ def main(argv: list[str] | None = None) -> int:
                         rescue_overlong_max=args.rescue_overlong_max,
                         rescue_window=args.rescue_window,
                         fault_plan=args.fault_plan,
+                        merge_overlap=args.merge_overlap,
                         autotune="hint" if args.autotune else "off")
     except ValueError as e:
         parser.error(str(e))
@@ -635,6 +670,27 @@ def main(argv: list[str] | None = None) -> int:
             if resolved == "hot-cache" else None)
         print(f"combiner: auto -> {resolved}"
               + ("" if records else " (no ledger history)"), file=sys.stderr)
+
+    if args.merge_strategy == "auto":
+        # Resolve 'auto' BEFORE any trace, against the static reduction
+        # planner's tuned profiles (tools/redplan.py --out writes the
+        # modeled winner next to the geometry/autotune profiles) — the
+        # geometry/combiner 'auto' discipline: resolution is the driver's
+        # job, and the RESOLVED strategy is stamped into this run's
+        # run_start, never the literal 'auto'.  The CLI drives a 1-D
+        # mesh, so only single-axis strategies are eligible — a hier-*
+        # winner planned over a 2-D fleet mesh is skipped, and no
+        # matching profile falls back loudly to 'tree'.
+        from mapreduce_tpu.obs import history
+
+        single_axis = tuple(s for s in MERGE_STRATEGIES
+                            if not s.startswith("hier-"))
+        prior = history.resolve_prior(profile_path=args.geometry_profile,
+                                      merge_allowed=single_axis)
+        args.merge_strategy = prior["merge_strategy"]
+        print(f"merge-strategy: auto -> {args.merge_strategy}"
+              + ("" if prior["merge_strategy_profile"]
+                 else " (no redplan profile; tree)"), file=sys.stderr)
 
     from mapreduce_tpu.runtime import profiling
 

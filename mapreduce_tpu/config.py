@@ -33,6 +33,12 @@ SEGMIN_TPU_ERROR = (
 #: a single-digit multiple of the documented 64-bit key envelope.
 COMBINER_SALT_BITS = 3
 
+#: The collective merge strategies the runtime builds, mirrored here so
+#: Config stays jax-free (parallel/collectives.py STRATEGIES is the
+#: source of truth; the bijection is test-pinned in test_collective.py).
+MERGE_STRATEGIES = ("tree", "gather", "keyrange", "hier-kr-tree",
+                    "hier-tree-tree")
+
 
 def radix_slab_cap(bits: int, block_rows: int, slab_slack: int) -> int:
     """Resolved radix slab rows per (block, lane, bucket): the slack
@@ -504,6 +510,30 @@ class Config:
     # degradation ladder (revert-geometry -> combiner-off -> map-split ->
     # sort-xla) before giving up.
     failure_policy: object = None
+    # Collective merge strategy for the global reduction (ISSUE 20): a
+    # name from ``MERGE_STRATEGIES`` ('tree', 'gather', 'keyrange',
+    # 'hier-kr-tree', 'hier-tree-tree' — parallel/collectives.py builds
+    # them, analysis/meshcost.py prices them), or 'auto' = resolve from
+    # the redplan tuned.json profile BEFORE building the engine — the
+    # driver's job, exactly the combiner/geometry 'auto' contract (the
+    # CLI resolves via obs/history.resolve_prior; an unresolved 'auto'
+    # behaves as 'tree', the incumbent).  The hierarchical placements
+    # need a multi-axis mesh; the keyrange family needs a job with a
+    # keyrange_merge hook — both checked by the Engine at build.
+    merge_strategy: str = "tree"
+    # Window-boundary collective overlap (ISSUE 20 leg 2): at every
+    # window-drain/checkpoint boundary the executor drains each host's
+    # local table into a resident merged accumulator with an async
+    # partial collective and resets the local table, so the DCN transfer
+    # of window N overlaps the ingest+compute of window N+1 and table
+    # pressure stays bounded by the window.  Byte-exact to the
+    # monolithic merge (commutative fold + min-position rule; chaos- and
+    # gloo-pair-certified).  Requires retry=0 (the replay anchor
+    # machinery snapshots the local state, which a partial merge has
+    # partially shipped); each partial lands as an op='partial'
+    # `collective` ledger record (ledger v10).  Off (default): the old
+    # single-finish ledger shape, bit-identical programs.
+    merge_overlap: bool = False
 
     def __post_init__(self) -> None:
         if self.chunk_bytes % 128 != 0:
@@ -614,6 +644,15 @@ class Config:
         if self.autotune not in ("off", "hint"):
             raise ValueError(f"unknown autotune mode {self.autotune!r} "
                              "(expected 'off' or 'hint')")
+        if self.merge_strategy != "auto" \
+                and self.merge_strategy not in MERGE_STRATEGIES:
+            raise ValueError(
+                f"unknown merge_strategy {self.merge_strategy!r} (expected "
+                f"'auto' or one of {list(MERGE_STRATEGIES)})")
+        if not isinstance(self.merge_overlap, bool):
+            raise ValueError(
+                f"merge_overlap must be a bool, got "
+                f"{type(self.merge_overlap).__name__}")
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {self.superstep}")
         if self.inflight_groups < 1:
@@ -724,6 +763,15 @@ class Config:
         g = self.resolved_geometry
         return g.compact_slots if self.sort_mode == "stable2" \
             else g.sort3_slots
+
+    @property
+    def resolved_merge_strategy(self) -> str:
+        """The merge strategy the engine actually builds (see
+        ``merge_strategy``): an unresolved 'auto' behaves as 'tree' (the
+        incumbent) — resolution against the redplan tuned.json profile is
+        the driver's job (CLI / bench), never the engine's."""
+        return "tree" if self.merge_strategy == "auto" \
+            else self.merge_strategy
 
     @property
     def resolved_combiner(self) -> str:

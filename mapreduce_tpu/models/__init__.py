@@ -203,6 +203,20 @@ def _wordcount_fleet2(config: Config):
                   local_devices=4)
 
 
+def _wordcount_fleet2x4(config: Config):
+    from mapreduce_tpu.models.wordcount import WordCountJob
+
+    # Pinned config: the 2-host x 4-device twin on the PLACED hierarchical
+    # merge (ISSUE 20) — key-range all_to_all + owner-reduce + all_gather
+    # confined to the inner ICI axis, then one butterfly tree leg across
+    # DCN — so the collective-cost pass prices the planner's 2-D
+    # skew-sensitive program (hier-kr-tree) in CI next to the per-level
+    # tree twin (_wordcount_fleet2) over the identical topology.
+    del config
+    return _fleet(WordCountJob(ANALYSIS_CONFIG), processes=2,
+                  local_devices=4, merge="hier-kr-tree")
+
+
 def _wordcount_fleet8(config: Config):
     from mapreduce_tpu.models.wordcount import WordCountJob
 
@@ -228,6 +242,7 @@ _REGISTRY: Dict[str, Callable[[Config], object]] = {
     "wordcount_telemetry": _wordcount_telemetry,
     "wordcount_fused_telemetry": _wordcount_fused_telemetry,
     "wordcount_fleet2": _wordcount_fleet2,
+    "wordcount_fleet2x4": _wordcount_fleet2x4,
     "wordcount_fleet8": _wordcount_fleet8,
 }
 

@@ -402,6 +402,15 @@ class GrepJob(MapReduceJob):
         path's semantics identical)."""
         return state._replace(line_carry=jnp.zeros_like(state.line_carry))
 
+    def partial_reset(self, local: GrepState) -> GrepState:
+        """Post-partial-merge reset (ISSUE 20 leg 2): the counters were
+        shipped into the resident accumulator, but ``line_carry`` is
+        CROSS-STEP context — the open line at this device's stream
+        position — which the next step's combine still corrects against.
+        Called per device inside shard_map on the LOCAL state."""
+        init = self.init_state()
+        return init._replace(line_carry=local.line_carry)
+
     # -- data-plane telemetry (ISSUE 11 satellite: grep previously forced
     # -- telemetered runs into plain mode, leaving the classifier — and the
     # -- combiner's 'auto' switch — blind to this family) -----------------

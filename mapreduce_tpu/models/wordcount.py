@@ -746,6 +746,13 @@ class WordCountJob:
 
         return collectives.key_range_merge(self._plain_table(state), axis)
 
+    def keyrange_result_merge(self, a, b) -> table_ops.CountTable:
+        """Merge two keyrange RESULTS (plain replicated CountTables) —
+        the fold the hier-kr-tree outer tree legs and the overlap
+        accumulator run on.  Batched-state cadence is irrelevant here:
+        keyrange_merge already folded any pending rows."""
+        return table_ops.merge(a, b, capacity=self.capacity)
+
     def finalize(self, state):
         return self._plain_table(state)
 
@@ -984,6 +991,17 @@ class NGramCountJob(WordCountJob):
 
         return collectives.key_range_merge(state.table, axis)
 
+    def partial_reset(self, local):
+        """Post-partial-merge reset (ISSUE 20 leg 2): the gram table was
+        shipped into the resident accumulator, so it returns to empty —
+        but the seam carry is CROSS-STEP context (the tail bytes of the
+        previous chunk row), which the next step's combine still needs.
+        Called per device inside shard_map on the LOCAL state."""
+        init = self.init_state()
+        if self.n == 1 or not isinstance(local, NGramState):
+            return init
+        return NGramState(table=init.table, carry=local.carry)
+
     def on_input_boundary(self, state):
         """Files are independent corpora: grams must not span a file seam.
 
@@ -1199,6 +1217,14 @@ class _SketchComposedJob:
         return self.state_cls(
             self.base.keyrange_merge(table_state, axis),
             collectives.tree_merge(sketch, self._merge, axis))
+
+    def keyrange_result_merge(self, a, b):
+        """Merge two keyrange results (``state_cls(plain_table, sketch)``
+        pairs): the base job's result merge on the table, the sketch's
+        own monoid on the sketch — the hier-kr-tree outer-leg / overlap-
+        accumulator fold."""
+        return self.state_cls(self.base.keyrange_result_merge(a[0], b[0]),
+                              self._merge(a[1], b[1]))
 
     def finalize(self, state):
         if self.flush_every == 1:

@@ -265,6 +265,29 @@ def _intervals(recs: List[dict], rid: Optional[str]):
     return out
 
 
+def _overlap_seconds(spans, others) -> float:
+    """Seconds of ``spans`` covered by the union of ``others`` — the
+    overlap-HIDDEN share of a host's collective time (ISSUE 20): a
+    window-boundary partial merge in flight while the host's other lanes
+    stay busy costs no exclusive wall-clock, so the fleet verdict charges
+    only the visible remainder."""
+    if not spans or not others:
+        return 0.0
+    merged: List[List[float]] = []
+    for s, e in sorted(others):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    total = 0.0
+    for s, e in spans:
+        for ms, me in merged:
+            lo, hi = max(s, ms), min(e, me)
+            if lo < hi:
+                total += hi - lo
+    return total
+
+
 def fleet_view(by_host: Dict[int, List[dict]],
                run_id: Optional[str] = None, *,
                selected=None) -> Optional[dict]:
@@ -302,7 +325,15 @@ def fleet_view(by_host: Dict[int, List[dict]],
             if hb is not None:
                 have_host_bytes = True
                 host_bytes += int(hb)
-        coll = sum(e - s for lane, s, e, _ in iv if lane == "collective")
+        coll_spans = [(s, e) for lane, s, e, _ in iv if lane == "collective"]
+        other_spans = [(s, e) for lane, s, e, _ in iv
+                       if lane != "collective"]
+        coll = sum(e - s for s, e in coll_spans)
+        # Overlap accounting (ISSUE 20): window-boundary partial merges
+        # run while the map lanes are still busy — that hidden share
+        # costs no exclusive wall-clock, so the verdict below charges
+        # only the visible remainder (the total stays in collective_s).
+        hidden = _overlap_seconds(coll_spans, other_spans)
         tokens = sum(int(_num(r.get("tokens")) or 0) for r in recs
                      if r.get("kind") == "data")
         art = arts.get(h)
@@ -315,6 +346,8 @@ def fleet_view(by_host: Dict[int, List[dict]],
             "device_busy_s": (art or {}).get("lane_busy_s", {}).get(
                 "device", 0.0),
             "collective_s": round(coll, 6),
+            "collective_hidden_s": round(hidden, 6),
+            "collective_visible_s": round(coll - hidden, 6),
             "bottleneck": ((art or {}).get("bottleneck") or {}).get(
                 "resource"),
         }
@@ -348,10 +381,18 @@ def fleet_view(by_host: Dict[int, List[dict]],
     coll_per_host = {str(h): per_host[str(h)]["collective_s"] for h in hosts}
     coll_vals = [v for v in coll_per_host.values() if v]
     coll_mean = sum(coll_vals) / len(coll_vals) if coll_vals else 0.0
+    vis_vals = [per_host[str(h)]["collective_visible_s"] for h in hosts
+                if per_host[str(h)]["collective_s"]]
+    vis_mean = sum(vis_vals) / len(vis_vals) if vis_vals else 0.0
 
     straggler_s = round(total_skew, 6)
     collective_s = round(coll_mean, 6)
-    if span > 0 and straggler_s >= collective_s \
+    # The verdict charges only the VISIBLE collective share: seconds a
+    # window-boundary partial merge spent overlapped with busy map lanes
+    # are already paid for, and switching strategy cannot win them back.
+    visible_s = round(vis_mean, 6)
+    hidden_s = round(collective_s - visible_s, 6)
+    if span > 0 and straggler_s >= visible_s \
             and straggler_s / span > FLEET_MIN_FRAC:
         # Saving capped at the span: per-superstep skews are summed, and
         # a consistently slow host can accumulate more lag-seconds than
@@ -365,19 +406,26 @@ def fleet_view(by_host: Dict[int, List[dict]],
                   "supersteps — a perfectly balanced fleet saves "
                   f"~{straggler_s:.3f}s; rebalance the data before "
                   "touching collective strategy")
-    elif span > 0 and collective_s > straggler_s \
-            and collective_s / span > FLEET_MIN_FRAC:
-        verdict, saving = "collective-bound", collective_s
-        detail = (f"the collective finish costs {collective_s:.3f}s of "
+    elif span > 0 and visible_s > straggler_s \
+            and visible_s / span > FLEET_MIN_FRAC:
+        verdict, saving = "collective-bound", visible_s
+        detail = (f"the collective finish costs {visible_s:.3f}s of "
                   f"the {span:.3f}s fleet span "
-                  f"({100 * collective_s / span:.0f}%), more than the "
+                  f"({100 * visible_s / span:.0f}%), more than the "
                   f"{straggler_s:.3f}s host skew — the reduction "
                   "strategy/schedule is the lever (ROADMAP item 3)")
+        if hidden_s > 0:
+            detail += (f" (a further {hidden_s:.3f}s of collective time "
+                       "already hides inside the map stream)")
     else:
-        verdict, saving = "balanced", max(straggler_s, collective_s)
+        verdict, saving = "balanced", max(straggler_s, visible_s)
         detail = (f"neither host skew ({straggler_s:.3f}s) nor the "
-                  f"collective finish ({collective_s:.3f}s) clears "
+                  f"visible collective finish ({visible_s:.3f}s) clears "
                   f"{FLEET_MIN_FRAC:.0%} of the {span:.3f}s fleet span")
+        if hidden_s > 0:
+            detail += (f" — window-boundary overlap hides {hidden_s:.3f}s "
+                       f"of the {collective_s:.3f}s total collective time "
+                       "inside the map stream")
 
     imbalance_counters = {
         h: {k: v for k, v in (("bytes", per_host[str(h)]["host_bytes"]),
@@ -407,12 +455,16 @@ def fleet_view(by_host: Dict[int, List[dict]],
             "per_host_lag_s": {str(h): round(lag[h], 6) for h in hosts},
         },
         "collective": {"mean_s": collective_s,
+                       "visible_mean_s": visible_s,
+                       "hidden_mean_s": hidden_s,
                        "per_host_s": coll_per_host},
         "fleet_bottleneck": {
             "verdict": verdict,
             "projected_saving_s": round(saving, 6),
             "straggler_s": straggler_s,
             "collective_s": collective_s,
+            "collective_visible_s": visible_s,
+            "collective_hidden_s": hidden_s,
             "span_s": round(span, 6),
             "detail": detail,
         },
@@ -533,6 +585,8 @@ def render(view: dict, out) -> None:
         out.write(f"  h{h}: {p['groups']} groups, device busy "
                   f"{p['device_busy_s']:.3f}s, collective "
                   f"{p['collective_s']:.3f}s")
+        if p.get("collective_hidden_s"):
+            out.write(f" ({p['collective_hidden_s']:.3f}s overlapped)")
         if p.get("host_bytes") is not None:
             out.write(f", host bytes {p['host_bytes']}")
         if p.get("bottleneck"):
@@ -544,7 +598,11 @@ def render(view: dict, out) -> None:
                   f"across {st['supersteps']} supersteps; slowest host "
                   f"{st['slowest_host']} "
                   f"({st['slowest_wins']}/{st['supersteps']})\n")
-    out.write(f"  collective: mean {view['collective']['mean_s']:.3f}s\n")
+    out.write(f"  collective: mean {view['collective']['mean_s']:.3f}s")
+    if view["collective"].get("hidden_mean_s"):
+        out.write(f" ({view['collective']['hidden_mean_s']:.3f}s hidden "
+                  "by window-boundary overlap)")
+    out.write("\n")
     bn = view["fleet_bottleneck"]
     out.write(f"  fleet bottleneck: {bn['verdict']} — {bn['detail']}\n")
     imb = view["imbalance"]
@@ -649,6 +707,50 @@ def selftest() -> int:
     assert cbn["projected_saving_s"] == 1.5, cbn  # the 1.5 s finish
     assert cview["imbalance"]["verdict"] == "balanced", cview["imbalance"]
 
+    # Overlap accounting (ISSUE 20): the same amount of collective time,
+    # but shipped as a window-boundary partial merge that rides INSIDE
+    # the map stream — the hidden share charges nothing and the verdict
+    # flips to balanced.  Hand arithmetic: device lane 1.0-4.0, partial
+    # 1.5-2.8 fully inside it (hidden 1.3), finish 4.05-4.25 exclusive
+    # (visible 0.2); span 0.99-4.26 = 3.27, visible 0.2/3.27 = 6% < 10%.
+    def co(op, s, e):
+        return {"run_id": "o", "kind": "collective", "op": op,
+                "strategy": "tree", "step": 0,
+                "started_at": s, "ended_at": e}
+
+    def rso(h):
+        return {"run_id": "o", "kind": "run_start", "host": h,
+                "processes": 2, "clock": {"wall": 50.0, "mono": 0.0}}
+
+    def go(h):
+        return {"run_id": "o", "kind": "group", "host": h, "step_first": 0,
+                "step_last": 0, "group_bytes": 64, "staged_at": 0.99,
+                "dispatched_at": 1.0, "token_ready_at": 4.0 + 0.01 * h,
+                "retired_at": 4.01 + 0.01 * h}
+
+    ov = {h: [rso(h), go(h), co("partial", 1.5, 2.8),
+              co("finish", 4.05, 4.25)] for h in (0, 1)}
+    oview = fleet_view(ov)
+    oph = oview["per_host"]["0"]
+    assert oph["collective_s"] == 1.5 and oph["collective_hidden_s"] == 1.3 \
+        and oph["collective_visible_s"] == 0.2, oph
+    assert oview["collective"]["visible_mean_s"] == 0.2 \
+        and oview["collective"]["hidden_mean_s"] == 1.3, oview["collective"]
+    obn = oview["fleet_bottleneck"]
+    assert obn["verdict"] == "balanced", obn
+    assert obn["collective_s"] == 1.5 and obn["collective_visible_s"] == 0.2, obn
+    assert "overlap hides 1.300s" in obn["detail"], obn
+    # The exclusive twin: the SAME 1.5 s of collective time, but the
+    # partial fires after the map lanes drain -> all visible, and the
+    # old collective-bound verdict comes back.
+    ex = {h: [rso(h), go(h), co("partial", 4.3, 5.6),
+              co("finish", 4.05, 4.25)] for h in (0, 1)}
+    eview = fleet_view(ex)
+    ebn = eview["fleet_bottleneck"]
+    assert ebn["verdict"] == "collective-bound", ebn
+    assert ebn["collective_hidden_s"] == 0.0 \
+        and ebn["collective_visible_s"] == 1.5, ebn
+
     # Balanced: equal hosts, thin collective -> nothing clears 10%.
     bal = {0: [rs(0), g(0, 0, 1.0, 2.0)], 1: [rs(1), g(1, 0, 1.0, 2.0)]}
     bview = fleet_view(bal)
@@ -674,7 +776,8 @@ def selftest() -> int:
           f"{st['total_skew_s']}s over {st['supersteps']} supersteps, "
           f"verdict {bn['verdict']}, imbalance {imb['verdict']}, "
           f"{len(slices)} trace slices, byte-stable merge, "
-          "collective-bound/balanced/unaligned/future cases ok)")
+          "collective-bound/overlap-hidden/balanced/unaligned/future "
+          "cases ok)")
     return 0
 
 

@@ -192,11 +192,52 @@ def freshest_profile_geometry(profile_path: str, family: str = "wordcount",
     return "default"
 
 
+def freshest_profile_merge_strategy(profile_path: str,
+                                    mesh_label: Optional[str] = None,
+                                    allowed=None,
+                                    family: str = "wordcount-redplan"):
+    """The merge strategy a planned ``tuned.json`` profile warm-starts
+    (the ``merge_strategy='auto'`` read, ISSUE 20): the freshest
+    ``tools/redplan.py --out`` winner — keys
+    ``wordcount-redplan/static/<mesh-label>-cap<capacity>`` — whose
+    planned mesh geometry matches.  ``mesh_label`` (a
+    ``meshcost.MeshSpec.label()`` like ``'2dx4i'``) pins the exact
+    geometry; ``allowed`` filters to strategies the RUNTIME mesh can
+    execute (a ``hier-*`` winner planned over a 2-D fleet mesh is
+    invalid on a 1-D runtime mesh, so a 1-D caller passes the
+    single-axis set).  Returns ``(strategy, profile_key)``;
+    ``(None, None)`` when nothing matches — the caller owns the loud
+    fallback to ``'tree'``, so "no prior" stays distinguishable from
+    "the planner picked tree"."""
+    try:
+        with open(profile_path, encoding="utf-8") as f:
+            profiles = json.load(f).get("profiles", {})
+    except (OSError, ValueError):
+        return None, None
+    mine = {key: entry for key, entry in profiles.items()
+            if isinstance(entry, dict) and key.startswith(family)}
+    for key, entry in sorted(mine.items(),
+                             key=lambda kv: kv[1].get("recorded_at") or "",
+                             reverse=True):
+        label = (entry.get("mesh") or {}).get("label")
+        if mesh_label is not None and label != mesh_label:
+            continue
+        strategy = (entry.get("config") or {}).get("merge_strategy")
+        if not isinstance(strategy, str) or strategy == "auto":
+            continue
+        if allowed is not None and strategy not in allowed:
+            continue
+        return strategy, key
+    return None, None
+
+
 def resolve_prior(*, records: Optional[Iterable[dict]] = None,
                   run_id: Optional[str] = None,
                   profile_path: Optional[str] = None,
                   family: str = "wordcount",
                   presets=None, geometry_ok=None,
+                  mesh_label: Optional[str] = None,
+                  merge_allowed=None,
                   index_dir: Optional[str] = None,
                   config_key: Optional[str] = None,
                   group: Optional[str] = None) -> dict:
@@ -210,7 +251,13 @@ def resolve_prior(*, records: Optional[Iterable[dict]] = None,
       (:func:`run_view`) ``derive_signals`` consumes;
     * ``profile_path`` (a searched ``tuned.json``): the geometry it
       warm-starts (exactly the old ``analysis.geometry.resolve_auto``
-      semantics — pass ``presets``/``geometry_ok`` for validation);
+      semantics — pass ``presets``/``geometry_ok`` for validation),
+      plus the merge strategy the static reduction planner's freshest
+      profile warm-starts (ISSUE 20: ``mesh_label`` pins the planned
+      mesh geometry, ``merge_allowed`` restricts to strategies the
+      runtime mesh can execute; no match resolves to ``'tree'`` with
+      ``merge_strategy_profile=None``, so callers can announce the
+      fallback loudly);
     * ``index_dir`` (+ ``config_key`` or ``group``): the warehouse
       prior — the latest matching index row and the group's drift
       verdict (the serving layer's warm-start / billing read).
@@ -220,6 +267,7 @@ def resolve_prior(*, records: Optional[Iterable[dict]] = None,
     keys at their neutral value — absence of a prior is itself
     information, never an error."""
     out: dict = {"combiner": "off", "geometry": "default",
+                 "merge_strategy": "tree", "merge_strategy_profile": None,
                  "run_id": run_id, "run_records": [], "fleet": None,
                  "data_record": None, "data_health": None, "history": None}
     if records is not None:
@@ -234,6 +282,11 @@ def resolve_prior(*, records: Optional[Iterable[dict]] = None,
     if profile_path is not None:
         out["geometry"] = freshest_profile_geometry(
             profile_path, family, presets=presets, geometry_ok=geometry_ok)
+        strategy, key = freshest_profile_merge_strategy(
+            profile_path, mesh_label=mesh_label, allowed=merge_allowed)
+        if strategy is not None:
+            out["merge_strategy"] = strategy
+            out["merge_strategy_profile"] = key
     if index_dir is not None:
         index = read_index(index_dir)
         if index is not None:
@@ -857,6 +910,31 @@ def selftest() -> int:
         assert p["geometry"] == "tall512", p
         assert resolve_prior(profile_path=os.path.join(d, "nope.json"))[
             "geometry"] == "default"
+        # (2b) merge strategy (ISSUE 20): freshest redplan profile whose
+        # planned mesh matches; mesh-label/allowed misses fall back to
+        # 'tree' with a None profile key (the caller's loud-fallback cue).
+        with open(prof, "w", encoding="utf-8") as f:
+            json.dump({"profiles": {
+                "wordcount-redplan/static/2dx4i-cap262144": {
+                    "recorded_at": "2026-03-01T00:00:00",
+                    "mesh": {"label": "2dx4i"},
+                    "config": {"merge_strategy": "hier-kr-tree"}},
+                "wordcount-redplan/static/8i-cap262144": {
+                    "recorded_at": "2026-02-01T00:00:00",
+                    "mesh": {"label": "8i"},
+                    "config": {"merge_strategy": "keyrange"}}}}, f)
+        mp = resolve_prior(profile_path=prof)
+        assert mp["merge_strategy"] == "hier-kr-tree" \
+            and mp["merge_strategy_profile"] \
+            == "wordcount-redplan/static/2dx4i-cap262144", mp
+        mp = resolve_prior(profile_path=prof, mesh_label="8i")
+        assert mp["merge_strategy"] == "keyrange", mp
+        mp = resolve_prior(profile_path=prof,
+                           merge_allowed=("tree", "gather", "keyrange"))
+        assert mp["merge_strategy"] == "keyrange", mp  # hier-* filtered
+        mp = resolve_prior(profile_path=prof, mesh_label="16i")
+        assert mp["merge_strategy"] == "tree" \
+            and mp["merge_strategy_profile"] is None, mp
         # (3) the derive_signals run view: first stamped run chosen, and
         # a merged fleet stream anchors on host 0 (never the chimera).
         merged = [
@@ -889,7 +967,7 @@ def selftest() -> int:
     print("history selftest ok (6 fixture runs, regressing/config-drift/"
           "improving/steady/no-history verdicts, streak 4, byte-stable "
           "re-ingest, 10-instance mini zoo + fleet + future flow-through, "
-          "resolve_prior parity x4)")
+          "resolve_prior parity x4 + redplan merge-strategy warm-start)")
     return 0
 
 

@@ -45,11 +45,16 @@ tune           one per run on ``Config(autotune='hint')`` runs (ISSUE 10,
                (bottleneck resource, projected-saving fraction, data
                verdict, window stats), and the full rule-by-rule decision
                trail.  Advisory: the live run is never changed
-collective     one per run (ISSUE 13, inside the reduce phase): the
-               collective finish's monotonic interval (started_at/
-               ended_at) + merge strategy — the raw material of the
-               fleet timeline's ``collective`` lane (strategy *builds*
-               stay registry metrics: they happen at trace time)
+collective     the collective reduction's monotonic interval
+               (started_at/ended_at) + merge strategy + ``op`` (ledger
+               v10): ``op="finish"`` is the end-of-stream global reduce
+               (ISSUE 13, one per run, inside the reduce phase);
+               ``op="partial"`` is a window-boundary overlap merge
+               (ISSUE 20, ``Config.merge_overlap`` runs only: one per
+               retired partial, stamped with the boundary ``step``) —
+               together the raw material of the fleet timeline's
+               ``collective`` lane (strategy *builds* stay registry
+               metrics: they happen at trace time)
 progress       the live-run heartbeat (ISSUE 14, ledger v8): emitted on
                a wall-clock cadence from the dispatch/retire points —
                stream cursor + total bytes + completion fraction,
@@ -127,8 +132,17 @@ from typing import Iterator, Optional
 #: from/to), ``retry``/``failure`` records gain ``fault_class`` (+
 #: ``seam`` on non-dispatch retries), and run_start stamps the
 #: ``fault_plan`` spec on chaos runs.  Fault-free runs emit no new
-#: records and no new fields beyond the version stamp.
-LEDGER_VERSION = 9
+#: records and no new fields beyond the version stamp;
+#: 10 = placed reductions at runtime (ISSUE 20): ``collective`` records
+#: gain ``op`` ("finish" = the end-of-stream reduce, exactly the v7
+#: record; "partial" = a window-boundary overlap merge, one per retired
+#: partial with its boundary ``step``), run_start stamps
+#: ``merge_overlap: true`` on overlapped runs (absent otherwise), and
+#: ``merge_strategy`` may now name a hierarchical 2-D program
+#: (``hier-kr-tree`` / ``hier-tree-tree``).  Overlap-off runs emit no
+#: new records and no new fields beyond the version stamp and the
+#: finish record's ``op`` tag.
+LEDGER_VERSION = 10
 
 
 def shard_path(path: str, process_index: int) -> str:

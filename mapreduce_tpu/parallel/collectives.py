@@ -240,12 +240,49 @@ def hierarchical_merge(state: T, merge: MergeFn, axes: tuple[str, ...],
     plan (SURVEY §7 step 4).  Axes are given outermost-first, matching mesh
     construction order.
     """
+    if strategy == "hier-tree-tree":
+        strategy = "tree"  # the named 2-D descriptor for the same schedule
     if strategy not in ("tree", "gather"):
         raise ValueError(f"unknown strategy {strategy!r}")
     fn = tree_merge if strategy == "tree" else gather_merge
     for axis in reversed(axes):
         state = fn(state, merge, axis)
     return state
+
+
+def hier_tree_tree_merge(state: T, merge: MergeFn,
+                         axes: tuple[str, ...]) -> T:
+    """The named 2-D tree composition (planner descriptor
+    ``hier-tree-tree``): butterfly per level, innermost (ICI) axis first,
+    so the outer (DCN) level moves one already-merged payload per slice.
+    Exactly :func:`hierarchical_merge` with ``strategy='tree'`` — named so
+    the planner's descriptor table maps one-to-one onto a runtime builder.
+    """
+    return hierarchical_merge(state, merge, axes, strategy="tree")
+
+
+def hier_kr_tree_merge(state: T, keyrange_fn, result_merge: MergeFn,
+                       axes: tuple[str, ...]) -> T:
+    """Placed 2-D reduction (planner descriptor ``hier-kr-tree``):
+    key-range reduce-scatter on the INNERMOST axis (the ICI level, where
+    the budgeted all_to_all's 2sM bytes are cheap and the owner merges are
+    capacity/D-sized), then butterfly tree over the OUTER axes (the DCN
+    level crosses once per round with the already-reduced payload).
+
+    ``keyrange_fn(state, axis)`` is the job's ``keyrange_merge`` hook: it
+    folds any batched shape and returns the replicated REDUCED result
+    (wordcount family: a plain CountTable).  ``result_merge`` must be a
+    merge valid on that result shape (the job's ``keyrange_result_merge``
+    hook) — the outer tree legs run on keyrange's output, not on the raw
+    accumulator shape.
+    """
+    if len(axes) < 2:
+        raise ValueError(
+            f"hier-kr-tree composes two mesh levels; got axes {axes!r}")
+    merged = keyrange_fn(state, axes[-1])
+    for axis in reversed(axes[:-1]):
+        merged = tree_merge(merged, result_merge, axis)
+    return merged
 
 
 # Reduction-strategy descriptors (ISSUE 16): the machine-readable surface
@@ -273,5 +310,19 @@ STRATEGIES: dict[str, dict] = {
         "power_of_two_only": False,
         "needs_keyrange_hook": True,  # Engine requires job.keyrange_merge
         "per_axis": False,  # flattens the whole mesh into one collective
+    },
+    # The 2-D placed compositions (ISSUE 20): whole-mesh builders that
+    # assign a strategy per link level the way the planner prices them.
+    "hier-kr-tree": {
+        "builder": f"{__name__}.hier_kr_tree_merge",
+        "power_of_two_only": True,  # the outer tree legs (gather fallback)
+        "needs_keyrange_hook": True,  # inner leg is the job keyrange hook
+        "per_axis": False,  # fixed placement: keyrange inner, tree outer
+    },
+    "hier-tree-tree": {
+        "builder": f"{__name__}.hier_tree_tree_merge",
+        "power_of_two_only": True,
+        "needs_keyrange_hook": False,
+        "per_axis": False,  # the named whole-mesh composition
     },
 }

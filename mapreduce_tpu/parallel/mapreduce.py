@@ -124,14 +124,24 @@ class Engine:
         self.n_devices = 1
         for a in axes:
             self.n_devices *= mesh.shape[a]
-        if merge_strategy not in ("tree", "gather", "keyrange"):
+        if merge_strategy == "auto":
+            raise ValueError(
+                "merge_strategy='auto' reaches the Engine unresolved: "
+                "resolution (via the redplan tuned.json profile) is the "
+                "driver's job — pass the resolved strategy name")
+        if merge_strategy not in collectives.STRATEGIES:
             raise ValueError(f"unknown merge_strategy {merge_strategy!r}")
-        if merge_strategy == "keyrange" \
+        if merge_strategy in ("keyrange", "hier-kr-tree") \
                 and getattr(job, "keyrange_merge", None) is None:
             raise ValueError(
-                "merge_strategy='keyrange' needs a job with a keyrange_merge "
-                "hook (the CountTable wordcount family); use 'tree'/'gather' "
-                f"for {type(job).__name__}")
+                f"merge_strategy={merge_strategy!r} needs a job with a "
+                "keyrange_merge hook (the CountTable wordcount family); "
+                f"use 'tree'/'gather' for {type(job).__name__}")
+        if merge_strategy.startswith("hier-") and len(axes) < 2:
+            raise ValueError(
+                f"merge_strategy={merge_strategy!r} composes two mesh "
+                f"levels; the mesh has one axis ({axes[0]!r}) — use "
+                "'tree'/'gather'/'keyrange' on single-axis meshes")
         # Data-plane telemetry (ISSUE 8): when on, step/step_many return
         # ``(state, DataStats)`` — the stats leaves are tiny uint32 scalars
         # per shard, a NON-donated second output the executor fetches at
@@ -148,22 +158,36 @@ class Engine:
                     f"data_stats=True but {type(job).__name__} has no "
                     "map_chunk_stats_sharded/state_stats hooks")
         self.data_stats = bool(data_stats)
+        self.merge_strategy = merge_strategy
         self._keyrange = merge_strategy == "keyrange"
+        # The keyrange-family strategies return the job's keyrange RESULT
+        # shape (wordcount family: a plain replicated CountTable), so any
+        # further fold of their output — the hier outer tree legs, the
+        # overlap accumulator — must use the job's result-shape merge.
+        self._kr_family = merge_strategy in ("keyrange", "hier-kr-tree")
+        self._result_merge = getattr(job, "keyrange_result_merge", None) \
+            if self._kr_family else None
+        if self._kr_family and self._result_merge is None:
+            self._result_merge = job.merge
         # Multi-axis meshes reduce level by level (innermost = fastest link
         # first); single-axis meshes use the chosen strategy directly.
         # Keyrange flattens the axes inside its single all_to_all round
-        # (the job hook receives the full axis tuple).
-        self._collective = None if self._keyrange else (
+        # (the job hook receives the full axis tuple); the hier-*
+        # placements compose a strategy per level (_merge_local).
+        self._collective = None if self._kr_family else (
             functools.partial(
                 collectives.hierarchical_merge, strategy=merge_strategy)
             if len(axes) > 1 else
-            (collectives.tree_merge if merge_strategy == "tree"
-             else collectives.gather_merge))
+            (collectives.tree_merge if merge_strategy
+             in ("tree", "hier-tree-tree") else collectives.gather_merge))
         self._sharded = mesh_mod.sharded(mesh, axes if len(axes) > 1 else axes[0])
         self._replicated = mesh_mod.replicated(mesh)
         self._step_fn = None
         self._step_many_fns: dict[tuple[int, int], Any] = {}  # (K, repeats)
         self._finish_fn = None
+        self._partial_fns: dict[bool, Any] = {}  # with_accum -> program
+        self._residual_fn = None
+        self._reset_fn = None
         self._rep_fn = None
 
     def _device_index(self):
@@ -300,20 +324,105 @@ class Engine:
                        in_shardings=(self._sharded, self._sharded,
                                      self._replicated))
 
+    def _merge_local(self, local):
+        """The configured cross-device reduction of one local state —
+        traced inside shard_map.  Returns the REPLICATED merged value:
+        the job state shape for tree/gather, the job's keyrange RESULT
+        shape for the keyrange family (finalize accepts both)."""
+        job, axis = self.job, self.axis
+        if self._keyrange:
+            return job.keyrange_merge(local, axis)
+        if self.merge_strategy == "hier-kr-tree":
+            return collectives.hier_kr_tree_merge(
+                local, job.keyrange_merge, self._result_merge, self.axes)
+        return self._collective(local, job.merge, axis)
+
+    def _fold_merged(self, latest, accum):
+        """Fold the latest merged window into the accumulator — the
+        overlap accumulator's monoid: the result-shape merge for the
+        keyrange family, the job merge otherwise.  The LATEST value is
+        operand ``a`` deliberately: counters are commutative, but jobs
+        that keep one operand's coordination leaves (grep's line_carry,
+        NGram's seam carry) keep ``a``'s — and the monolithic finish
+        would report the stream-end value of those leaves."""
+        return self._result_merge(latest, accum) if self._kr_family \
+            else self.job.merge(latest, accum)
+
     def _build_finish(self):
         axis, job = self.axis, self.job
 
         def final(state):
             local = jax.tree.map(lambda x: x[0], state)
-            if self._keyrange:
-                merged = job.keyrange_merge(local, axis)
-            else:
-                merged = self._collective(local, job.merge, axis)
-            return job.finalize(merged)
+            return job.finalize(self._merge_local(local))
 
         fn = shard_map(
             final, mesh=self.mesh,
             in_specs=(P(axis),), out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def _build_partial(self, with_accum: bool):
+        """The window-boundary partial collective (ISSUE 20 leg 2): merge
+        the current local states across the mesh with the SAME configured
+        strategy the finish uses, folding into the resident accumulator
+        when one exists.  Replicated output; nothing finalized."""
+        axis = self.axis
+
+        def first(state):
+            local = jax.tree.map(lambda x: x[0], state)
+            return self._merge_local(local)
+
+        def fold(accum, state):
+            local = jax.tree.map(lambda x: x[0], state)
+            return self._fold_merged(self._merge_local(local), accum)
+
+        fn = shard_map(
+            fold if with_accum else first, mesh=self.mesh,
+            in_specs=(P(), P(axis)) if with_accum else (P(axis),),
+            out_specs=P(),
+            check_vma=False,
+        )
+        # No donation: the executor dispatches this async at a window
+        # boundary and then resets the local table from the same buffers;
+        # tables are small next to the staged input stream.
+        return jax.jit(fn)
+
+    def _build_residual(self):
+        """Stream-end finish under overlap: merge the residual local
+        states, fold the accumulator in, finalize — one program, so the
+        final collective record stays one span like the monolithic path."""
+        axis, job = self.axis, self.job
+
+        def final(accum, state):
+            local = jax.tree.map(lambda x: x[0], state)
+            return job.finalize(
+                self._fold_merged(self._merge_local(local), accum))
+
+        fn = shard_map(
+            final, mesh=self.mesh,
+            in_specs=(P(), P(axis)), out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def _build_reset(self):
+        """Post-partial local reset: every device returns to its init
+        state — except jobs carrying cross-step seam context, whose
+        ``partial_reset`` hook preserves it (NGram keeps the carry; the
+        gram table itself was shipped by the partial merge)."""
+        job = self.job
+        axis = self.axis
+        fn_hook = getattr(job, "partial_reset", None)
+
+        def reset(state):
+            local = jax.tree.map(lambda x: x[0], state)
+            new = fn_hook(local) if fn_hook is not None else job.init_state()
+            return jax.tree.map(lambda x: x[None], new)
+
+        fn = shard_map(
+            reset, mesh=self.mesh,
+            in_specs=(P(axis),), out_specs=P(axis),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -360,6 +469,37 @@ class Engine:
         if self._finish_fn is None:
             self._finish_fn = self._build_finish()
         return self._finish_fn(state)
+
+    def partial_merge(self, accum: Any, state: Any) -> Any:
+        """Window-boundary partial collective (ISSUE 20 leg 2): reduce
+        the current per-device states across the mesh with the configured
+        strategy and fold into ``accum`` (pass ``None`` for the first
+        window).  Returns the new replicated accumulator — dispatched
+        async by the executor so the DCN transfer overlaps the next
+        window's ingest."""
+        key = accum is not None
+        if key not in self._partial_fns:
+            self._partial_fns[key] = self._build_partial(key)
+        return self._partial_fns[key](accum, state) if key \
+            else self._partial_fns[key](state)
+
+    def finish_residual(self, accum: Any, state: Any) -> Any:
+        """Stream-end finish under overlap: merge the residual states,
+        fold ``accum`` in, finalize.  With ``accum=None`` (no partial was
+        ever dispatched) this is exactly :meth:`finish`."""
+        if accum is None:
+            return self.finish(state)
+        if self._residual_fn is None:
+            self._residual_fn = self._build_residual()
+        return self._residual_fn(accum, state)
+
+    def partial_reset(self, state: Any) -> Any:
+        """Fresh per-device states after a partial merge shipped the old
+        ones (jobs with cross-step seam context override ``partial_reset``
+        to keep it — NGram's carry)."""
+        if self._reset_fn is None:
+            self._reset_fn = self._build_reset()
+        return self._reset_fn(state)
 
     def run(self, batches, progress: Callable[[int], None] | None = None) -> Any:
         """Convenience: fold an iterable of [D, C] uint8 batches and finish."""

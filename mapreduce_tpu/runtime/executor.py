@@ -180,6 +180,12 @@ class _StreamHooks:
     # (run_job_global) disables the ladder: resource exhaustion there
     # fails over to checkpoint/resume like every other global failure.
     rebuild: Any = None
+    # Window-boundary collective overlap (ISSUE 20 leg 2): the driver's
+    # :class:`_OverlapMerger`, or None (default — the old single-finish
+    # shape, bit-identical programs and ledger).  Only valid with
+    # retry=0: the replay anchor snapshots local state a partial merge
+    # has partially shipped.
+    overlap: Any = None
 
 
 class _StagePool:
@@ -374,15 +380,17 @@ def _job_with_config(job, config: Config):
     return j
 
 
-def _collective_finish(engine, state, plan, policy, tel, write: bool,
-                       logger):
-    """``engine.finish`` behind the collective-finish seam (ISSUE 15).
+def _collective_call(thunk, plan, policy, tel, write: bool, logger):
+    """A collective dispatch behind the collective-finish seam (ISSUE 15
+    refactored for ISSUE 20: the stream-end finish AND the window-boundary
+    partial merges cross the SAME seam, so chaos plans written against the
+    old grammar exercise both).
 
-    Injected faults fire BEFORE the finish runs, so retrying them on the
-    transient/resource budget is always safe; a real collective failure
-    is classified + recorded and propagates — in a fleet, peer processes
-    are blocked mid-program, and checkpoint/resume is the recovery path
-    (the run_job_global no-retry contract)."""
+    Injected faults fire BEFORE the collective runs, so retrying them on
+    the transient/resource budget is always safe; a real collective
+    failure is classified + recorded and propagates — in a fleet, peer
+    processes are blocked mid-program, and checkpoint/resume is the
+    recovery path (the run_job_global no-retry contract)."""
     attempt = 0
     while True:
         try:
@@ -392,7 +400,7 @@ def _collective_finish(engine, state, plan, policy, tel, write: bool,
                     _record_fault(tel, write, exc, seam="collective-finish",
                                   injected=True, index=exc.index)
                     raise exc
-            return engine.finish(state)
+            return thunk()
         except faults_mod.FaultError as fe:
             if not fe.injected or fe.fault_class == "preemption":
                 raise
@@ -415,6 +423,106 @@ def _collective_finish(engine, state, plan, policy, tel, write: bool,
             _record_fault(tel, write, e, seam="collective-finish",
                           injected=False)
             raise
+
+
+def _collective_finish(engine, state, plan, policy, tel, write: bool,
+                       logger):
+    """``engine.finish`` through the collective-finish seam (the
+    monolithic stream-end merge)."""
+    return _collective_call(lambda: engine.finish(state), plan, policy,
+                            tel, write, logger)
+
+
+class _OverlapMerger:
+    """Window-boundary collective overlap (ISSUE 20 leg 2).
+
+    One resident replicated accumulator plus at most one in-flight
+    partial collective.  At a window-drain/checkpoint boundary the driver
+    calls :meth:`boundary`: the previous partial is retired lazily (it
+    owns a completion token exactly like a window group, and it had a
+    whole window of ingest to hide behind), the current local tables are
+    drained into the accumulator by an async-dispatched partial merge
+    through the collective-finish seam, and the local tables are reset —
+    so the DCN transfer of window N overlaps the ingest+compute of
+    window N+1 and table pressure stays bounded by the window.  Each
+    retired partial lands as an op='partial' ``collective`` ledger record
+    (ledger v10) carrying its real dispatch->token-ready interval: the
+    in-stream interconnect time obs/timeline's collective lane,
+    ``fleet_bottleneck`` and obswatch read.  Byte-exact to the monolithic
+    merge: the fold is the job's commutative merge (min-position rule),
+    certified by the chaos harness and the 2-process gloo pair."""
+
+    def __init__(self, engine, tel, write_gate, plan, policy, logger,
+                 strategy: str, window_cap: int):
+        self.engine = engine
+        self.tel = tel
+        self.write_gate = write_gate
+        self.plan = plan
+        self.policy = policy
+        self.logger = logger
+        self.strategy = strategy
+        self.window_cap = max(1, int(window_cap))
+        self.accum = None
+        self.partials = 0
+        self._retired_at_last = 0
+        self._inflight = None  # (token, started_at, step)
+
+    def disarm(self) -> None:
+        """Preemption shutdown: no further injected faults (the stream
+        loop disarms its own plan reference the same way)."""
+        self.plan = None
+
+    def due(self, retired_groups: int) -> bool:
+        """A partial fires when a full window's worth of groups retired
+        since the last boundary — a pure function of the group sequence,
+        so every process of a fleet dispatches the same partial at the
+        same point (the partial is one SPMD program)."""
+        return retired_groups - self._retired_at_last >= self.window_cap
+
+    def retire(self) -> None:
+        """Observe the previous partial's completion (usually long since
+        ready) and write its ledger record with the real interval."""
+        if self._inflight is None:
+            return
+        token, t0, step = self._inflight
+        self._inflight = None
+        _wait_token(token)
+        self.tel.ledger_write(
+            "collective", op="partial", strategy=self.strategy,
+            step=step, started_at=t0,
+            ended_at=round(time.perf_counter(), 6),
+            write=self.write_gate())
+
+    def boundary(self, state, step: int, retired_groups: int):
+        """Async-dispatch a partial merge of ``state`` into the
+        accumulator and return the reset local state."""
+        self.retire()
+        t0 = round(time.perf_counter(), 6)
+        self.accum = _collective_call(
+            lambda: self.engine.partial_merge(self.accum, state),
+            self.plan, self.policy, self.tel, self.write_gate(),
+            self.logger)
+        self._inflight = (_state_token(self.accum), t0, step)
+        self.partials += 1
+        self._retired_at_last = retired_groups
+        self.tel.event("partial_merge", step=step)
+        return self.engine.partial_reset(state)
+
+    def host_accum(self):
+        """The accumulator as host numpy (checkpoint packing).  The
+        partial's output is fully replicated, so the fetch is addressable
+        on every process; retire() first so the fetch never waits."""
+        self.retire()
+        if self.accum is None:
+            return None
+        return jax.tree.map(lambda x: np.array(x, copy=True), self.accum)
+
+    def accum_template(self):
+        """Abstract accumulator shapes (checkpoint resume template): the
+        first partial's output for this engine's strategy and job."""
+        eng = self.engine
+        return jax.eval_shape(lambda: eng.partial_merge(
+            None, eng.init_states()))
 
 
 @dataclasses.dataclass
@@ -604,6 +712,7 @@ def _drive_stream(engine, job, config: Config, path, state,
         else faults_mod.FailurePolicy.resolve(None, retry=hooks.retry)
     cur_config = config
     window_cap = max(1, config.inflight_groups)
+    overlap = hooks.overlap
     window: collections.deque = collections.deque()
     # retry > 0: host snapshot of the state at the current anchor point —
     # the replay source.  (Re)taken lazily before the first dispatch of a
@@ -1213,6 +1322,19 @@ def _drive_stream(engine, job, config: Config, path, state,
             while len(window) >= window_cap:
                 pipe["full_retires"] += 1
                 state = retire_oldest(state)
+            # Window-boundary partial merge (ISSUE 20 leg 2): a full
+            # window's worth of groups has retired since the last
+            # boundary — drain the local tables into the resident
+            # accumulator (async; the DCN transfer hides behind the
+            # next window) and reset them.  The dispatch is host work
+            # ("dispatch" phase); the previous partial's lazy retire is
+            # a wait ("retire_wait"), normally instant.
+            if overlap is not None and overlap.due(retired_groups):
+                with obs.span("retire_wait", timer):
+                    overlap.retire()
+                with obs.span("dispatch", timer):
+                    state = overlap.boundary(state, step_index,
+                                             retired_groups)
         cursor_before = bytes_done
         # Lifecycle (ISSUE 7): read_at = the group's first batch leaving
         # the reader; staged_at is stamped by _group_life right here, just
@@ -1255,6 +1377,16 @@ def _drive_stream(engine, job, config: Config, path, state,
             state = drain_window(state)
             pipe["boundary_drains"] += 1
             last_ckpt = step_index // checkpoint_every
+            # Checkpoint boundaries are window boundaries too (ISSUE 20
+            # leg 2): drain the local tables into the accumulator so the
+            # snapshot packs {"s": reset local state, "a": accumulator}
+            # — resume restores both and the stream stays byte-exact.
+            if overlap is not None:
+                with obs.span("retire_wait", timer):
+                    overlap.retire()
+                with obs.span("dispatch", timer):
+                    state = overlap.boundary(state, step_index,
+                                             retired_groups)
             ck_before = timer["checkpoint"]
             with obs.span("checkpoint", timer):
                 # retry mode just re-anchored on this very state: reuse the
@@ -1266,6 +1398,9 @@ def _drive_stream(engine, job, config: Config, path, state,
                 # in the stream loop).
                 state_host = anchor if hooks.retry > 0 \
                     else hooks.snapshot(state)
+                if overlap is not None:
+                    state_host = {"s": state_host,
+                                  "a": overlap.host_accum()}
                 saved = save_snapshot(state_host)
             tel.event("checkpoint", step=step_index, cursor_bytes=bytes_done)
             if saved:
@@ -1346,6 +1481,16 @@ def _drive_stream(engine, job, config: Config, path, state,
                 # edit invalidates the replay anchor (re-taken lazily).
                 state = drain_window(state, do_reanchor=False)
                 pipe["boundary_drains"] += 1
+                # File boundaries are window boundaries too: ship the old
+                # corpus member's counts before the hook edits the carry
+                # (partial_reset preserves seam context; the hook then
+                # zeroes it exactly as it would on the monolithic state).
+                if overlap is not None:
+                    with obs.span("retire_wait", timer):
+                        overlap.retire()
+                    with obs.span("dispatch", timer):
+                        state = overlap.boundary(state, step_index,
+                                                 retired_groups)
                 state = boundary_hook(state)
                 anchor = None
                 del since_anchor[:]
@@ -1387,6 +1532,8 @@ def _drive_stream(engine, job, config: Config, path, state,
         if faults_mod.classify(pe) != "preemption":
             raise
         plan = None
+        if overlap is not None:
+            overlap.disarm()
         state = drain_window(state, do_reanchor=False)
         checkpointed = False
         if checkpoint_path:
@@ -1398,7 +1545,15 @@ def _drive_stream(engine, job, config: Config, path, state,
                 # state degrades to an uncheckpointed (still orderly)
                 # exit, never a crash inside the drain handler.
                 try:
+                    if overlap is not None:
+                        # Preemption is a boundary too: ship the local
+                        # tables so the packed snapshot resumes exactly.
+                        state = overlap.boundary(state, step_index,
+                                                 retired_groups)
                     state_host = hooks.snapshot(state)
+                    if overlap is not None:
+                        state_host = {"s": state_host,
+                                      "a": overlap.host_accum()}
                 except Exception as se:
                     _record_fault(tel, hooks.write_gate(), se,
                                   seam="checkpoint-save", injected=False,
@@ -1429,6 +1584,10 @@ def _drive_stream(engine, job, config: Config, path, state,
     pipe["window_filled"] = pipe["depth_max"] >= window_cap
     pipe["full_frac"] = round(pipe["full_retires"] / n_groups, 3) \
         if n_groups else 0.0
+    # Only stamped when overlap ran: overlap-off runs keep the exact old
+    # pipeline dict shape (the ledger A/B control).
+    if overlap is not None:
+        pipe["partial_merges"] = overlap.partials
     return state, bytes_done, step_index, pipe
 
 
@@ -1472,7 +1631,7 @@ def _metrics_word_count(value) -> int:
 
 
 def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
-            mesh=None, merge_strategy: str = "tree",
+            mesh=None, merge_strategy: Optional[str] = None,
             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
             logger=None, progress_every: int = 50,
             byte_range: Optional[tuple[int, int]] = None,
@@ -1516,6 +1675,12 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         raise ValueError(f"retry must be >= 0, got {retry}")
     logger = logger or get_logger()
     tel = obs.maybe(telemetry)
+    # The strategy the engine builds: an explicit argument wins (the
+    # pre-ISSUE-20 call convention); None defers to the config, whose
+    # unresolved 'auto' behaves as 'tree' — 'auto' resolution against
+    # the redplan profile is the CLI/bench driver's job.
+    merge_strategy = merge_strategy if merge_strategy is not None \
+        else config.resolved_merge_strategy
     # Unified failure policy + fault plan (ISSUE 15): the legacy `retry`
     # counter resolves into per-class budgets (None policy = exactly the
     # old semantics), and the policy's dispatch budget is what arms the
@@ -1525,6 +1690,22 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     policy = faults_mod.FailurePolicy.resolve(config.failure_policy,
                                               retry=retry)
     retry = policy.dispatch_budget
+    if config.merge_overlap and retry > 0:
+        if config.failure_policy is None:
+            raise ValueError(
+                "merge_overlap requires retry=0: the replay anchor "
+                "snapshots local state that a window-boundary partial "
+                "merge has already shipped into the accumulator — "
+                "checkpoint/resume is the recovery path for overlapped "
+                "runs")
+        # An explicit policy keeps its per-class budgets on the seams
+        # that never replay shipped state (reader, checkpoint-save, and
+        # collective-finish — injected collective faults fire BEFORE the
+        # program runs, so retrying re-dispatches nothing the
+        # accumulator already holds), matching run_job_global's
+        # contract.  Window replay alone stays disarmed: its anchor
+        # would snapshot local tables a partial merge already drained.
+        retry = 0
     mesh = mesh if mesh is not None else data_mesh()
     # Shard over EVERY mesh axis: a 2-D ('replica','data') mesh contributes
     # all its devices to the data-parallel stream (the Engine linearizes the
@@ -1541,6 +1722,10 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     data_stats = tel.enabled and datastats_ops.supports(job)
     engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
                     merge_strategy=merge_strategy, data_stats=data_stats)
+    overlap = _OverlapMerger(engine, tel, lambda: True, plan, policy,
+                             logger, merge_strategy,
+                             config.inflight_groups) \
+        if config.merge_overlap else None
     data_agg = datastats_ops.DataAggregator.for_run(config, n_dev) \
         if data_stats else None
     range_lo, range_hi = byte_range if byte_range is not None else (0, None)
@@ -1563,11 +1748,22 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         # (shapes are ground truth).  A torn/corrupt snapshot falls back
         # to the previous good one (ISSUE 15 satellite; the fallback is
         # noted in the ledger after run_start) instead of crashing.
+        # Overlapped runs pack {"s": local state, "a": accumulator}
+        # (checkpoint boundaries always ship a partial first, so the
+        # accumulator exists in every overlap snapshot); the packed
+        # structure itself guards against resuming across an overlap
+        # on/off flip.
         template = jax.eval_shape(engine.init_states)
+        if overlap is not None:
+            template = {"s": template, "a": overlap.accum_template()}
         (state_np, start_step, start_offset, bases_arr, resumed_file), \
             ck_fallback = ckpt_mod.load_resilient(
                 checkpoint_path, template=template,
                 expect_fingerprint=fingerprint)
+        if overlap is not None:
+            overlap.accum = jax.device_put(state_np["a"],
+                                           engine._replicated)
+            state_np = state_np["s"]
         state = _owned_state(jax.device_put(state_np, engine._sharded))
         bases_list = list(bases_arr)
         log_event(logger, "resumed from checkpoint", step=start_step,
@@ -1632,7 +1828,8 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         stage_release=pool.give if retry > 0 else None,
         stage_arrival=None if retry > 0 else (lambda b: dataclasses.replace(
             b, data=jax.device_put(b.data, engine.sharding))),
-        rebuild=rebuild)
+        rebuild=rebuild,
+        overlap=overlap)
     if jax.process_count() > 1:
         # Per-host-driven multi-host (mode a): each host owns its whole
         # ledger file already, so no second shard file — but the records
@@ -1655,7 +1852,10 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      map_impl=config.map_impl,
                      combiner=config.resolved_combiner,
                      **_geometry_stamp(config), **chaos_stamp,
-                     merge_strategy=merge_strategy, input=_path_names(path),
+                     merge_strategy=merge_strategy,
+                     **({"merge_overlap": True} if config.merge_overlap
+                        else {}),
+                     input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      retry=retry)
     if ck_fallback is not None:
@@ -1684,11 +1884,22 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         timer.stop("stream")
 
         with obs.span("reduce", timer):
+            # Overlap: retire the last in-flight partial (its ledger
+            # record lands with its real interval), then the residual
+            # finish merges only what arrived after the last boundary.
+            if overlap is not None:
+                overlap.retire()
             fin_t0 = time.perf_counter()
-            value = _collective_finish(engine, state, plan, policy, tel,
-                                       True, logger)
+            if overlap is not None:
+                value = _collective_call(
+                    lambda: engine.finish_residual(overlap.accum, state),
+                    plan, policy, tel, True, logger)
+            else:
+                value = _collective_finish(engine, state, plan, policy,
+                                           tel, True, logger)
             value = jax.tree.map(np.asarray, value)  # block + fetch the result
-            # One `collective` record per run (ISSUE 13): the observed
+            # One op='finish' `collective` record per run (ISSUE 13;
+            # op='partial' records joined it in ledger v10): the observed
             # finish interval + merge strategy — the fleet timeline's
             # `collective` lane (strategy builds stay registry metrics).
             tel.ledger_write("collective", op="finish",
@@ -1738,7 +1949,7 @@ def run_job(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
 
 
 def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
-                   mesh=None, merge_strategy: str = "tree",
+                   mesh=None, merge_strategy: Optional[str] = None,
                    checkpoint_path: Optional[str] = None,
                    checkpoint_every: int = 0,
                    logger=None, progress_every: int = 50,
@@ -1789,6 +2000,8 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     # path) and no degradation ladder (rebuild=None: every process would
     # have to step in lockstep).  The policy still drives reader/
     # checkpoint-save/collective-finish retries and the token timeout.
+    merge_strategy = merge_strategy if merge_strategy is not None \
+        else config.resolved_merge_strategy
     plan = faults_mod.FaultPlan.resolve(config.fault_plan)
     policy = faults_mod.FailurePolicy.resolve(config.failure_policy,
                                               retry=0)
@@ -1801,6 +2014,15 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     # Data-plane telemetry is the per-host-driven / single-host story.
     engine = Engine(job, mesh, axis=axes if len(axes) > 1 else axes[0],
                     merge_strategy=merge_strategy)
+    # Window-boundary overlap (ISSUE 20 leg 2) — THE fleet scenario: the
+    # partial merge is one SPMD program every process dispatches at the
+    # same deterministic boundary, so the DCN transfer of window N rides
+    # under window N+1's ingest.  The global driver has no retry, so no
+    # gating is needed here.
+    overlap = _OverlapMerger(engine, tel, dist.is_coordinator, plan,
+                             policy, logger, merge_strategy,
+                             config.inflight_groups) \
+        if config.merge_overlap else None
     mine = np.asarray(dist.host_shards(n_dev), dtype=np.int64)
 
     timer = metrics_mod.PhaseTimer()
@@ -1844,10 +2066,21 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
     ck_fallback = None
     if checkpoint_path and ckpt_mod.exists(checkpoint_path):
         template = jax.eval_shape(engine.init_states_global)
+        if overlap is not None:
+            # Overlap snapshots pack {"s": local state, "a": accumulator}
+            # (every checkpoint boundary ships a partial first).
+            template = {"s": template, "a": overlap.accum_template()}
         (state_np, start_step, start_offset, bases_arr, resumed_file), \
             ck_fallback = ckpt_mod.load_resilient(
                 checkpoint_path, template=template,
                 expect_fingerprint=fingerprint)
+        if overlap is not None:
+            # The accumulator is fully replicated: every process holds
+            # the identical host value, so local data = global value.
+            overlap.accum = jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    engine._replicated, np.asarray(x)), state_np["a"])
+            state_np = state_np["s"]
         # _owned_state: the resumed tree is donated into the first global
         # step — a raw transfer-created buffer is not donation-safe.
         state = _owned_state(
@@ -1873,7 +2106,8 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         write_gate=dist.is_coordinator,
         retry=0,
         stage_release=stage_release,
-        host_rows=mine)
+        host_rows=mine,
+        overlap=overlap)
     # Pod-scale observability (ISSUE 13, ledger v7): every process writes
     # its own `<ledger>.h<p>.jsonl` shard (host-stamped records, the
     # run-epoch clock pair in run_start, per-host flight dumps); the
@@ -1897,6 +2131,8 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
                      combiner=config.resolved_combiner,
                      **_geometry_stamp(config), **chaos_stamp,
                      merge_strategy=merge_strategy,
+                     **({"merge_overlap": True} if config.merge_overlap
+                        else {}),
                      input=_path_names(path),
                      resume_step=start_step, resume_offset=start_offset,
                      write=dist.is_coordinator())
@@ -1922,12 +2158,20 @@ def run_job_global(job: MapReduceJob, path, config: Config = DEFAULT_CONFIG,
         timer.stop("stream")
 
         with obs.span("reduce", timer):
+            if overlap is not None:
+                overlap.retire()
             fin_t0 = time.perf_counter()
             # Replicated finish: addressable everywhere.  The collective-
             # finish seam + injected-fault retry budget wrap it (ISSUE
             # 15); real collective failures classify, record, propagate.
-            value = _collective_finish(engine, state, plan, policy, tel,
-                                       dist.is_coordinator(), logger)
+            if overlap is not None:
+                value = _collective_call(
+                    lambda: engine.finish_residual(overlap.accum, state),
+                    plan, policy, tel, dist.is_coordinator(), logger)
+            else:
+                value = _collective_finish(engine, state, plan, policy,
+                                           tel, dist.is_coordinator(),
+                                           logger)
             value = jax.tree.map(np.asarray, value)
             # Every host times the SAME collective finish from its own
             # side (ISSUE 13): the fleet `collective` lane + the

@@ -56,11 +56,17 @@ Data-shape verdicts whose knobs are OUTSIDE the tuned set (spill-bound →
 ``--compact-slots``, rescue-heavy → the rescue budgets) are noted in the
 decision trail but never produce a move: the tuner must not thrash
 pipeline knobs to chase a data problem.  The same discipline covers the
-cross-host ``fleet_bottleneck`` verdict (ISSUE 13): a merged fleet
-ledger's straggler-/collective-bound verdict rides the trail as a note —
-its knobs (data rebalancing, reduction strategy/schedule) are ROADMAP
-item 3's, and chasing it stays future work.  skew-hot GRADUATED from that
-set in ISSUE 11: the ``combiner`` knob is tuned now, so the
+cross-host straggler verdict (ISSUE 13): a merged fleet ledger's
+straggler-bound verdict rides the trail as a note — its knob (data
+rebalancing across hosts) is ROADMAP item 3's, and chasing it stays
+future work.  collective-bound GRADUATED in ISSUE 20: the runtime now
+owns two knobs that answer it directly — ``merge_overlap`` (window-
+boundary partial merges hide the finish inside the map stream) and
+``merge_strategy`` (the placed reduction program) — so the
+``fleet-collective-bound`` rule proposes enabling overlap first, then
+switching the strategy, instead of just pointing at ROADMAP item 3.
+skew-hot GRADUATED the same way
+in ISSUE 11: the ``combiner`` knob is tuned now, so the
 ``enable-combiner`` rule flips the map-side hot-key cache on instead of
 just pointing at it.  The
 ``table-pressure`` move is deliberately modest for the same reason — the
@@ -81,11 +87,14 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, List, Optional
 
-from mapreduce_tpu.config import Config, DEFAULT_CONFIG, GEOMETRY_PRESETS
+from mapreduce_tpu.config import (MERGE_STRATEGIES, Config, DEFAULT_CONFIG,
+                                  GEOMETRY_PRESETS)
 from mapreduce_tpu.obs import datahealth, history, timeline
 
 #: Bumped when the rule table / proposal schema changes shape.
-TUNER_VERSION = 1
+#: 2 = ISSUE 20: merge_strategy/merge_overlap joined the tuned set and
+#: the fleet-collective-bound rule fires instead of noting.
+TUNER_VERSION = 2
 
 #: The knobs this tuner owns, in proposal order.  ``combiner`` (ISSUE 11)
 #: and ``geometry`` (ISSUE 12) are the non-numeric knobs: mode/preset
@@ -93,9 +102,12 @@ TUNER_VERSION = 1
 #: by the pipeline ones.  Geometry knob values are 'default' or a
 #: ``config.GEOMETRY_PRESETS`` name — the tuned.json / ledger round-trip
 #: form (explicit Geometry dicts belong to the offline geomsearch
-#: driver, not the rule table).
+#: driver, not the rule table).  ``merge_strategy`` / ``merge_overlap``
+#: (ISSUE 20) are the placed-reduction knobs the fleet-collective-bound
+#: rule moves: a ``config.MERGE_STRATEGIES`` name and an 'off'/'on'
+#: string (the tuned.json round-trip form of the Config bool).
 KNOBS = ("inflight_groups", "prefetch_depth", "superstep", "chunk_bytes",
-         "combiner", "geometry")
+         "combiner", "geometry", "merge_strategy", "merge_overlap")
 
 #: Knobs that hold integers (everything result() must int-coerce).
 _INT_KNOBS = ("inflight_groups", "prefetch_depth", "superstep",
@@ -145,7 +157,10 @@ def default_knobs() -> dict:
             "superstep": DEFAULT_CONFIG.superstep,
             "chunk_bytes": DEFAULT_CONFIG.chunk_bytes,
             "combiner": DEFAULT_CONFIG.combiner,
-            "geometry": DEFAULT_CONFIG.geometry_label}
+            "geometry": DEFAULT_CONFIG.geometry_label,
+            "merge_strategy": DEFAULT_CONFIG.merge_strategy,
+            "merge_overlap": "on" if DEFAULT_CONFIG.merge_overlap
+            else "off"}
 
 
 def validate_knobs(knobs: dict, backend: str = "auto") -> None:
@@ -156,12 +171,18 @@ def validate_knobs(knobs: dict, backend: str = "auto") -> None:
     if backend not in ("auto", "xla", "pallas"):
         backend = "auto"  # resolved/CLI names like 'cpu' validate generically
     geometry = str(knobs.get("geometry", "default"))
+    overlap = str(knobs.get("merge_overlap", "off"))
+    if overlap not in ("off", "on"):
+        raise ValueError(f"merge_overlap knob must be 'off' or 'on', "
+                         f"got {overlap!r}")
     Config(chunk_bytes=int(knobs["chunk_bytes"]),
            superstep=int(knobs["superstep"]),
            inflight_groups=int(knobs["inflight_groups"]),
            prefetch_depth=int(knobs["prefetch_depth"]),
            combiner=str(knobs.get("combiner", "off")),
            geometry=None if geometry == "default" else geometry,
+           merge_strategy=str(knobs.get("merge_strategy", "tree")),
+           merge_overlap=overlap == "on",
            backend=backend)
 
 
@@ -232,6 +253,13 @@ def derive_signals(records: Iterable[dict],
     combiner = (start or {}).get("combiner")
     if isinstance(combiner, str):
         config["combiner"] = combiner
+    # Placed-reduction knobs (ISSUE 20): run_start stamps the RESOLVED
+    # strategy (never 'auto') and merge_overlap only when true.
+    ms = (start or {}).get("merge_strategy")
+    if isinstance(ms, str) and ms in MERGE_STRATEGIES:
+        config["merge_strategy"] = ms
+    if (start or {}).get("merge_overlap") is True:
+        config["merge_overlap"] = "on"
     geometry = (start or {}).get("geometry")
     geometry_custom = False
     if isinstance(geometry, str) \
@@ -361,13 +389,44 @@ def propose(records: Iterable[dict], run_id: Optional[str] = None,
     depth_max = sig["depth_max"]
     full_frac = sig["full_frac"]
 
-    # 0. Fleet verdict (ISSUE 13): noted, never chased.  A merged fleet
-    #    ledger's straggler-/collective-bound verdict names cross-host
-    #    costs whose knobs (data rebalancing, reduction strategy and
-    #    schedule — ROADMAP item 3) are outside this table; thrashing
-    #    single-host pipeline knobs against them would be the
+    # 0. Fleet verdict (ISSUE 13 -> ISSUE 20).  A collective-bound fleet
+    #    GRADUATED from note to move: the runtime owns the two knobs that
+    #    answer it — window-boundary overlap hides the finish inside the
+    #    map stream for free (byte-exact; requires retry=0), and the
+    #    merge strategy reshapes what is left.  Overlap first: it costs
+    #    nothing to try and the verdict already charges only the VISIBLE
+    #    collective share, so a still-collective-bound overlapped run has
+    #    genuinely unhidable finish time worth a strategy move.
+    if sig.get("fleet_bottleneck") == "collective-bound":
+        if consider("fleet-collective-bound",
+                    cur["merge_overlap"] == "off",
+                    "collective-bound fleet; window-boundary overlap off"):
+            return result(
+                "fleet-collective-bound",
+                "the visible collective finish dominates the fleet span: "
+                "enable window-boundary overlap so partial merges ride "
+                "inside the map stream (byte-exact to the monolithic "
+                "merge; requires retry=0)",
+                {"merge_overlap": "on"})
+        if consider("fleet-collective-bound",
+                    cur["merge_strategy"] == "tree",
+                    "collective-bound with overlap on; strategy 'tree'"):
+            return result(
+                "fleet-collective-bound",
+                "overlap already hides what it can and the per-level "
+                "tree finish still dominates: switch to the keyrange "
+                "owner-reduce program (bandwidth-optimal on one axis; "
+                "2-D hier-* programs stay redplan/registry territory)",
+                {"merge_strategy": "keyrange"})
+        consider("fleet-collective-bound", False,
+                 "collective-bound but overlap is on and the strategy is "
+                 f"{cur['merge_strategy']!r} — the remaining lever (2-D "
+                 "hierarchical placement) is redplan's, not this table's")
+    # A straggler-bound fleet stays a note, never chased: its knob is
+    #    data placement across hosts (ROADMAP item 3), and thrashing
+    #    single-host pipeline knobs against it would be the
     #    foreign-data-knob mistake at fleet scale.
-    if sig.get("fleet_bottleneck") not in (None, "balanced"):
+    elif sig.get("fleet_bottleneck") not in (None, "balanced"):
         consider(f"fleet-{sig['fleet_bottleneck']}", False,
                  f"fleet verdict {sig['fleet_bottleneck']!r} noted; its "
                  "knobs (host balance / reduction strategy) are outside "

@@ -24,6 +24,12 @@ is the multi-host hard-kill chaos scenario (``os._exit(113)`` between
 dispatched groups on every process at the same deterministic crossing,
 exactly like a synchronized platform reclaim; the relaunch resumes from
 the coordinator's checkpoint).
+
+``GW_MERGE_OVERLAP=1`` (env, ISSUE 20): window-boundary partial merges
+with ``inflight_groups=1`` (a partial every retired group, so even the
+tiny test corpus crosses several boundaries); ``GW_MERGE_STRATEGY``
+overrides the collective strategy.  Env-carried so the positional argv
+contract above stays stable.
 """
 
 from __future__ import annotations
@@ -71,8 +77,13 @@ def main() -> int:
 
         mr.Engine.step = crashing_step
 
+    overlap = os.environ.get("GW_MERGE_OVERLAP") == "1"
     cfg = Config(chunk_bytes=chunk_bytes, table_capacity=1 << 10,
-                 fault_plan=fault_plan or None)
+                 fault_plan=fault_plan or None,
+                 merge_strategy=os.environ.get("GW_MERGE_STRATEGY",
+                                               "tree"),
+                 merge_overlap=overlap,
+                 **({"inflight_groups": 1} if overlap else {}))
     telemetry = None
     if ledger_path:
         from mapreduce_tpu.obs import Telemetry

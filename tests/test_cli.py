@@ -316,7 +316,7 @@ def test_batch_ledger_without_stream(tmp_path, capsys):
     assert [x["kind"] for x in recs] == ["run_start", "data", "run_end"]
     start, data, end = recs
     assert start["driver"] == "single_buffer" and start["job"] == "wordcount"
-    assert start["ledger_version"] == 9
+    assert start["ledger_version"] == 10
     assert data["tokens"] == 5 and data["table_valid"] == 3
     assert data["top_count"] == 3 and data["dropped_tokens"] == 0
     assert end["words"] == 5 and end["elapsed_s"] > 0

@@ -65,21 +65,41 @@ def test_plan_rankings_and_skew_derating():
     0.3 derates keyrange by exactly 1.3x."""
     p = meshcost.plan(2, 4, 8192)
     assert [r["strategy"] for r in p["ranked"]] \
-        == ["gather", "tree", "keyrange"]
+        == ["gather", "tree", "hier-tree-tree", "hier-kr-tree", "keyrange"]
     assert p["payload_bytes"] == 7 * 4 * 8192 == 229376
+    # hier-tree-tree prices IDENTICAL to tree (same schedule, named
+    # placement) and the declaration-order tie-break keeps tree first.
+    by = {r["strategy"]: r["modeled_s"] for r in p["ranked"]}
+    assert by["hier-tree-tree"] == by["tree"]
     p = meshcost.plan(2, 4, 32768, top_mass=0.3, table_occupancy=0.85,
                       incumbent="tree")
     assert [r["strategy"] for r in p["ranked"]] \
-        == ["tree", "gather", "keyrange"]
+        == ["tree", "hier-tree-tree", "gather", "hier-kr-tree", "keyrange"]
     assert p["incumbent_is_top"] is True
     kr = next(r for r in p["ranked"] if r["strategy"] == "keyrange")
     levels = meshcost.load_link_rates()["levels"]
-    base = meshcost.keyrange(meshcost.table_bytes(32768), 8,
-                             levels["dcn"], slack=2.0)
+    m = meshcost.table_bytes(32768)
+    base = meshcost.keyrange(m, 8, levels["dcn"], slack=2.0)
     assert math.isclose(kr["modeled_s"], base * 1.3, rel_tol=1e-6)
-    # No keyrange hook -> skipped with a reason, never silently priced.
+    # hier-kr-tree: skew derates the INNER keyrange leg only — the outer
+    # DCN tree leg carries no hot-owner partition.
+    hkt = next(r for r in p["ranked"] if r["strategy"] == "hier-kr-tree")
+    inner = meshcost.keyrange(m, 4, levels["ici"], slack=2.0)
+    outer = meshcost.allreduce_tree(m, 2, levels["dcn"])
+    assert math.isclose(hkt["modeled_s"], inner * 1.3 + outer, rel_tol=1e-6)
+    assert hkt["keyrange_budget_rows"] \
+        == meshcost.keyrange_budget_rows(32768, 4, 2.0)
+    # No keyrange hook -> skipped with a reason, never silently priced
+    # (hier-kr-tree's inner leg is the same hook).
     p = meshcost.plan(8, 1, 8192, has_keyrange_hook=False)
-    assert [s["strategy"] for s in p["skipped"]] == ["keyrange"]
+    assert [s["strategy"] for s in p["skipped"]] \
+        == ["keyrange", "hier-kr-tree"]
+    # Single-axis meshes have nothing to place over: both hier
+    # compositions are skipped, never priced as degenerates.
+    p1 = meshcost.plan(1, 8, 8192)
+    assert [s["strategy"] for s in p1["skipped"]] \
+        == ["hier-kr-tree", "hier-tree-tree"]
+    assert all("multi-axis" in s["why"] for s in p1["skipped"])
 
 
 @pytest.mark.smoke
@@ -88,6 +108,16 @@ def test_strategy_descriptors_bijection_with_runtime():
     (or miss one it does): names, builder functions, and feasibility
     constraints pinned equal across the jax-free mirror."""
     assert set(meshcost.STRATEGIES) == set(collectives.STRATEGIES)
+    # The hierarchical 2-D compositions are first-class descriptors on
+    # both sides, not runtime-only aliases.
+    assert {"hier-kr-tree", "hier-tree-tree"} <= set(meshcost.STRATEGIES)
+    # The jax-free Config mirror (the CLI/bench choices surface) names
+    # exactly the runtime set — 'auto' stays a driver-side alias, never
+    # a descriptor.
+    from mapreduce_tpu.config import MERGE_STRATEGIES
+
+    assert set(MERGE_STRATEGIES) == set(collectives.STRATEGIES)
+    assert "auto" not in MERGE_STRATEGIES
     for name, strat in meshcost.STRATEGIES.items():
         runtime = collectives.STRATEGIES[name]
         assert strat.builder == runtime["builder"], name
@@ -275,15 +305,24 @@ def test_hbm_cost_artifact_surfaces_collective_family(mesh8):
 
 @pytest.mark.slow
 def test_fleet_twins_clean_under_full_pipeline():
-    """Both fleet registry twins (2x4 tree, 8x1 keyrange) carry zero
-    error findings under the full default pipeline — the all-models gate
-    extension the ISSUE requires, scoped to the new twins so the fast
-    tier doesn't re-sweep the whole zoo (tier-1's --all-models run
-    covers that)."""
-    for name in ("wordcount_fleet2", "wordcount_fleet8"):
+    """All three fleet registry twins (2x4 tree, 2x4 hier-kr-tree, 8x1
+    keyrange) carry zero error findings under the full default pipeline —
+    the all-models gate extension the ISSUE requires, scoped to the new
+    twins so the fast tier doesn't re-sweep the whole zoo (tier-1's
+    --all-models run covers that)."""
+    labels = {"wordcount_fleet2": "2dx4i", "wordcount_fleet2x4": "2dx4i",
+              "wordcount_fleet8": "8d"}
+    arts = {}
+    for name, label in labels.items():
         job = models_mod.build_model(name)
         report = analysis.analyze_job(job, model=name)
         assert not report.errors, report.format_text()
         art = report.artifacts[name]["collective_cost"]
-        assert art["mesh"]["label"] == ("2dx4i" if name.endswith("2")
-                                        else "8d")
+        assert art["mesh"]["label"] == label
+        arts[name] = art
+    # The placed 2-D program (keyrange confined to ICI + one tree leg
+    # across DCN) prices BELOW the per-level tree twin over the identical
+    # topology — the planner's tradeoff, certified on the traced programs
+    # (the checked-in .collective.json baselines pin the same ordering).
+    assert arts["wordcount_fleet2x4"]["modeled_total_s"] \
+        < arts["wordcount_fleet2"]["modeled_total_s"]

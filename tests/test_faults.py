@@ -224,7 +224,7 @@ def test_seam_injection_classifies_and_recovers(tmp_path, rng, seam,
     start = next(iter(obs.read_ledger(led, kind="run_start")))
     assert start["fault_plan"] \
         == faults.FaultPlan.from_spec(cfg.fault_plan).spec
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 10
 
 
 def test_permanent_fault_fails_immediately(tmp_path, rng):
@@ -652,6 +652,52 @@ def test_chaos_certification_eight_plans(tmp_path, rng):
         covered
     corpus = make_corpus(rng, 2500, 150)
     _certify(tmp_path, corpus, _SLOW_PLANS)
+
+
+@pytest.mark.slow
+def test_chaos_midstream_partial_merge_identity(tmp_path, rng):
+    """ISSUE 20: the collective-finish seam fires on window-boundary
+    PARTIAL merges too (plan grammar unchanged).  Plans whose collective
+    faults land mid-stream — on partial-merge crossings, not just the
+    end-of-stream finish — must replay to counts bit-identical to the
+    overlap-OFF fault-free baseline."""
+    corpus = make_corpus(rng, 2000, 120)
+    path = _write(tmp_path, corpus)
+    base = executor.count_file(path, Config(chunk_bytes=512,
+                                            table_capacity=2048,
+                                            inflight_groups=2),
+                               mesh=data_mesh(2))
+    plans = [
+        # Crossing 0 is the FIRST partial (the finish is the last
+        # crossing), so both faults land mid-stream by construction.
+        "at=collective-finish:0:transient,at=collective-finish:2:transient",
+        "seed=11,rate=0.5,seams=collective-finish,max=4",
+    ]
+    for i, plan in enumerate(plans):
+        # Overlap disarms window replay, so the collective retries need
+        # an EXPLICIT policy (the legacy retry counter would raise);
+        # budget 4 covers the seeded plan's max=4 consecutive fires.
+        cfg = Config(chunk_bytes=512, table_capacity=2048,
+                     inflight_groups=2, merge_overlap=True,
+                     fault_plan=plan,
+                     failure_policy={"transient_retries": 4})
+        led = str(tmp_path / f"ov_{i}.jsonl")
+        with obs.Telemetry.create(ledger_path=led) as tel:
+            chaos = executor.count_file(path, cfg, mesh=data_mesh(2),
+                                        retry=0, telemetry=tel)
+        assert chaos.as_dict() == base.as_dict(), f"plan {plan!r} diverged"
+        assert chaos.total == base.total
+        colls = list(obs.read_ledger(led, kind="collective"))
+        n_partial = sum(1 for c in colls if c["op"] == "partial")
+        assert n_partial >= 2 and colls[-1]["op"] == "finish", colls
+        hits = [f for f in obs.read_ledger(led, kind="fault")
+                if f["seam"] == "collective-finish"]
+        assert hits and all(f["injected"] for f in hits), (plan, hits)
+        # At least one fault struck a PARTIAL crossing: crossing indices
+        # below the partial count belong to partials, not the finish.
+        assert min(f["index"] for f in hits) < n_partial, (plan, hits)
+        end = next(iter(obs.read_ledger(led, kind="run_end")))
+        assert end["pipeline"]["partial_merges"] == n_partial
 
 
 @pytest.mark.slow

@@ -304,6 +304,55 @@ def test_tuner_notes_fleet_verdict_without_chasing_it():
     assert not any(t["rule"].startswith("fleet-") for t in plain["trail"])
 
 
+def _collective_bound_merged(merge_strategy="tree", merge_overlap=False):
+    """A merged two-host stream whose fleet verdict is collective-bound:
+    negligible skew, a fat finish after the map lanes drain."""
+    def start(h):
+        rec = _rs(h, 50.0, 0.0, run_id="cb")
+        rec["merge_strategy"] = merge_strategy
+        if merge_overlap:
+            rec["merge_overlap"] = True
+        return rec
+
+    by_host = {h: [start(h),
+                   _group(h, 0, 0.99, 1.0, 2.0 + 0.01 * h, run_id="cb"),
+                   _coll(h, 2.1, 3.6, run_id="cb")] for h in (0, 1)}
+    return fleet.merged_records(by_host)
+
+
+def test_tuner_fires_on_collective_bound_fleet():
+    """ISSUE 20: collective-bound graduated from note to move.  The
+    escalation ladder — overlap off: enable merge_overlap; overlap on +
+    tree: switch to keyrange; both exhausted: note only."""
+    from mapreduce_tpu import tuning
+    from mapreduce_tpu.tuning import engine
+
+    prop = tuning.propose(_collective_bound_merged(), run_id="cb")
+    assert prop["signals"]["fleet_bottleneck"] == "collective-bound"
+    assert prop["rule"] == "fleet-collective-bound"
+    assert prop["changed"] == {"merge_overlap": ["off", "on"]}, prop
+    fired = next(t for t in prop["trail"]
+                 if t["rule"] == "fleet-collective-bound")
+    assert fired["fired"] is True, fired
+    engine.validate_knobs(prop["proposal"])
+
+    prop2 = tuning.propose(_collective_bound_merged(merge_overlap=True),
+                           run_id="cb")
+    assert prop2["rule"] == "fleet-collective-bound"
+    assert prop2["changed"] == {"merge_strategy": ["tree", "keyrange"]}
+    engine.validate_knobs(prop2["proposal"])
+
+    # Ladder exhausted: keyrange + overlap on -> a note, and the fired
+    # rule falls through to the normal single-host table.
+    prop3 = tuning.propose(
+        _collective_bound_merged(merge_strategy="keyrange",
+                                 merge_overlap=True), run_id="cb")
+    assert prop3["rule"] != "fleet-collective-bound"
+    notes = [t for t in prop3["trail"]
+             if t["rule"] == "fleet-collective-bound"]
+    assert notes and all(t["fired"] is False for t in notes), notes
+
+
 def test_tuner_signals_anchor_on_one_host_in_merged_ledgers():
     """A merged fleet stream holds every host's records under one run_id:
     reconstructing a timeline from ALL of them would fuse the hosts'
@@ -371,7 +420,7 @@ def test_telemetry_attach_host_opens_shard_and_stamps(tmp_path):
     assert [r["kind"] for r in shard] == ["run_start", "group", "checkpoint"]
     assert all(r["host"] == 1 for r in shard)
     start = shard[0]
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 10
     assert start["processes"] == 2 and start["local_devices"] == 2
     assert start["clock"] == {"wall": 10.0, "mono": 3.0}
     assert "clock" not in shard[1], "topology rides run_start only"

@@ -47,7 +47,12 @@ def test_separator_pathologies(config):
         _check(data, config)
 
 
-@pytest.mark.parametrize("config", [XLA, PALLAS], ids=["xla", "pallas"])
+@pytest.mark.parametrize(
+    "config",
+    [XLA,
+     # ~30 s on the one-core box; tier-1 budget rule
+     pytest.param(PALLAS, marks=pytest.mark.slow)],
+    ids=["xla", "pallas"])
 def test_words_at_length_envelope(config):
     """1-byte words, W-byte words (the pallas fast-path bound), and high-bit
     bytes that would sign-extend if the kernel widened incorrectly."""

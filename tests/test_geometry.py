@@ -343,7 +343,7 @@ def test_cli_geometry_surface(tmp_path, capsys):
     start = next(r for r in obs.read_ledger(str(led))
                  if r["kind"] == "run_start")
     assert start["geometry"] == "tall512"
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 10
 
 
 # -- the search artifact / selftest entry ------------------------------------

@@ -258,12 +258,74 @@ def test_run_job_global_host_kill_fault_resumes(tmp_path):
     assert got["counts"] == sorted(expected.values())
 
 
+@pytest.mark.slow
+def test_run_job_global_host_kill_after_partial_merge_resumes(tmp_path):
+    """ISSUE 20 chaos: hard-kill every process AFTER window-boundary
+    partial merges have drained local tables into the replicated
+    accumulator.  The shards must show op='partial' collective records
+    preceding the process-kill fault; the plan-free relaunch (overlap
+    still on) resumes from the coordinator's {state, accumulator}
+    snapshot to the exact oracle counts — the partial-merge/checkpoint
+    interaction the fast tier cannot cover with real collectives."""
+    import json
+    import os
+
+    corpus = (b"Hello World EveryOne\nWorld Good News\n"
+              b"Good Morning Hello\n" * 40)
+    path = tmp_path / "gp.txt"
+    path.write_bytes(corpus)
+    ckpt = str(tmp_path / "gp.ck.npz")
+    ledger = str(tmp_path / "gp.jsonl")
+
+    # inflight_groups=1 (the worker's overlap mode) + checkpoint_every=1
+    # fire a partial at every checkpoint boundary, so by process-kill
+    # crossing 2 (the last dispatched group on this corpus) two partials
+    # have merged and the latest snapshot holds the accumulator.
+    procs, outs = _launch_global_workers(
+        path, ckpt, crash_at=-1, ledger=ledger,
+        fault_plan="at=process-kill:2:permanent", merge_overlap=True)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 113, \
+            f"hard-kill missing:\nrc={p.returncode}\n{err[-2000:]}"
+    assert os.path.exists(ckpt), "no checkpoint written before the kill"
+    from mapreduce_tpu import obs
+
+    for proc_index in (0, 1):
+        shard = f"{ledger}.h{proc_index}.jsonl"
+        recs = list(obs.read_ledger(shard))
+        partial_ts = [r["ts"] for r in recs if r.get("kind") == "collective"
+                      and r.get("op") == "partial"]
+        kill_ts = [r["ts"] for r in recs if r.get("kind") == "fault"
+                   and r.get("seam") == "process-kill"]
+        assert partial_ts and kill_ts, (proc_index, recs)
+        assert min(partial_ts) < min(kill_ts), \
+            "the kill must land AFTER a partial merge retired"
+
+    # Plan-free relaunch, overlap still on: resume merges the snapshot's
+    # accumulator + residual to the exact oracle counts.
+    procs, outs = _launch_global_workers(path, ckpt, crash_at=-1,
+                                         merge_overlap=True)
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"resume failed:\n{err[-2000:]}"
+    json_lines = [ln for out, _ in outs for ln in out.splitlines()
+                  if ln.startswith("{")]
+    assert len(json_lines) == 1, json_lines
+    got = json.loads(json_lines[0])
+    expected = oracle.word_counts(corpus)
+    assert got["total"] == oracle.total_count(corpus)
+    assert got["distinct"] == len(expected)
+    assert got["counts"] == sorted(expected.values())
+
+
 def _launch_global_workers(path, ckpt, crash_at, ledger=None,
-                           chunk_bytes=256, fault_plan=None):
+                           chunk_bytes=256, fault_plan=None,
+                           merge_overlap=False):
     """Spawn the 2-process run_job_global gloo harness (global_worker.py);
     ``ledger`` attaches telemetry at a shared path (ISSUE 13);
     ``fault_plan`` arms the executor's injection seams (ISSUE 15 — the
-    process-kill seam is the host-kill chaos scenario)."""
+    process-kill seam is the host-kill chaos scenario);
+    ``merge_overlap`` turns on window-boundary partial merges at
+    inflight_groups=1 (ISSUE 20)."""
     import os
     import socket
     import subprocess
@@ -277,6 +339,10 @@ def _launch_global_workers(path, ckpt, crash_at, ledger=None,
     repo = Path(__file__).resolve().parent.parent
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["PYTHONPATH"] = str(repo)
+    if merge_overlap:
+        env["GW_MERGE_OVERLAP"] = "1"
+    else:
+        env.pop("GW_MERGE_OVERLAP", None)
     worker = str(repo / "tests" / "global_worker.py")
     argv = [sys.executable, worker, "PID", "2", str(port), str(path),
             str(chunk_bytes), "2", str(ckpt), str(crash_at)]
@@ -342,7 +408,7 @@ def test_run_job_global_multiprocess_writes_host_shards(tmp_path):
         recs = list(obs.read_ledger(sp))
         assert all(r.get("host") == h for r in recs)
         start = next(r for r in recs if r["kind"] == "run_start")
-        assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+        assert start["ledger_version"] == obs.LEDGER_VERSION == 10
         assert start["processes"] == 2 and start["local_devices"] == 2
         assert set(start["clock"]) == {"wall", "mono"}
         groups = [r for r in recs if r["kind"] == "group"]

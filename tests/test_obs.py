@@ -90,7 +90,7 @@ def test_ledger_run_start_carries_version(tmp_path):
         led.write("run_start", devices=1)
         led.write("step", step_first=0)
     recs = list(obs.read_ledger(p))
-    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert recs[0]["ledger_version"] == obs.LEDGER_VERSION == 10
     assert "ledger_version" not in recs[1]
 
 

@@ -125,6 +125,7 @@ def test_radix_spill_counts_and_falls_back_exactly(rng):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
 
 
+@pytest.mark.slow  # ~37 s on the one-core box; tier-1 budget rule
 def test_wordcount_radix_matches_oracle(corpus):
     """End-to-end wordcount through the radix aggregation seam: words,
     counts, insertion (first-occurrence) order, totals, and accounting all

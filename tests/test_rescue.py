@@ -60,6 +60,7 @@ class TestRescueExact:
         assert rp.as_dict()[url] == 17
         assert rp.as_dict() == rx.as_dict()
 
+    @pytest.mark.slow  # ~30 s on the one-core box; tier-1 budget rule
     def test_exact_at_w_boundaries(self, rng, oracle):
         # 32 is in-kernel, 33 is the smallest rescued length, window-1 the
         # largest; window stays dropped (covered in TestRescueEnvelope).
@@ -180,6 +181,7 @@ class TestRescueEnvelope:
         assert rp.dropped_count == 4
         assert rp.total == rx.total
 
+    @pytest.mark.slow  # ~19 s on the one-core box; tier-1 budget rule
     def test_no_overlong_bit_identical_to_rescue_off(self, rng):
         # The cond guard: overlong-free chunks must produce the same table
         # with rescue on or off (the branch never runs).

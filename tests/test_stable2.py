@@ -125,6 +125,7 @@ def test_stable2_overlong_rescue_matches(rng):
     assert a.as_dict() == oracle.word_counts(data)
 
 
+@pytest.mark.slow  # ~26 s on the one-core box; tier-1 budget rule
 def test_stable2_spill_falls_back_exactly():
     """Windows denser than the slot budget must spill into the
     full-resolution fallback (which aggregates with sort3 — pair layout is

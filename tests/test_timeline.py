@@ -90,7 +90,7 @@ def test_one_group_record_per_retired_group(piped_ledger):
     assert sum(g["group_bytes"] for g in groups) == corpus_bytes
     # run_start carries the stream schema version (forward-compat anchor).
     start = next(r for r in recs if r["kind"] == "run_start")
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 10
 
 
 def test_serial_window_is_gap_free_control(serial_ledger):

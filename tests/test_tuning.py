@@ -26,7 +26,8 @@ def _fixture(name: str) -> list:
 def _knobs(**kw) -> dict:
     base = {"chunk_bytes": 1 << 25, "superstep": 1,
             "inflight_groups": 4, "prefetch_depth": 4, "combiner": "off",
-            "geometry": "default"}
+            "geometry": "default", "merge_strategy": "tree",
+            "merge_overlap": "off"}
     base.update(kw)
     return base
 
@@ -333,7 +334,9 @@ def test_hint_run_emits_one_tune_record(hint_run):
     assert t["mode"] == "hint" and t["tuner_version"] == engine.TUNER_VERSION
     assert t["current"] == {"chunk_bytes": 512, "superstep": 1,
                             "inflight_groups": 3, "prefetch_depth": 3,
-                            "combiner": "off", "geometry": "default"}
+                            "combiner": "off", "geometry": "default",
+                            "merge_strategy": "tree",
+                            "merge_overlap": "off"}
     engine.validate_knobs(t["proposal"])
     assert t["rule"] and t["trail"] and "signals" in t
     assert rr.tune is not None and rr.tune["rule"] == t["rule"]
@@ -347,7 +350,7 @@ def test_hint_run_emits_one_tune_record(hint_run):
     assert t["signals"]["resource"] == art["bottleneck"]["resource"]
     # run_start stamps the v4 schema the tune record rides on.
     start = next(r for r in recs if r["kind"] == "run_start")
-    assert start["ledger_version"] == obs.LEDGER_VERSION == 9
+    assert start["ledger_version"] == obs.LEDGER_VERSION == 10
 
 
 @pytest.mark.slow

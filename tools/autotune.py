@@ -90,6 +90,11 @@ def _probe_config(knobs: dict):
                   # pairing benchwatch._tuned_env applies to the tuned rows.
                   map_impl="fused" if combiner == "hot-cache"
                   else Config.map_impl,
+                  # Placed-reduction knobs (ISSUE 20): round-trip as a
+                  # MERGE_STRATEGIES name and an 'off'/'on' string.
+                  merge_strategy=str(knobs.get("merge_strategy", "tree")),
+                  merge_overlap=str(knobs.get("merge_overlap",
+                                              "off")) == "on",
                   table_capacity=1 << 18,
                   batch_unique_capacity=1 << 16)
 
@@ -355,7 +360,9 @@ def selftest() -> int:
     assert r["stopped"] == "converged", r["stopped"]
     assert r["winner"] == {"chunk_bytes": 1 << 25, "superstep": 1,
                            "inflight_groups": 4, "prefetch_depth": 16,
-                           "combiner": "off", "geometry": "default"}, \
+                           "combiner": "off", "geometry": "default",
+                           "merge_strategy": "tree",
+                           "merge_overlap": "off"}, \
         r["winner"]
     assert [p["rule"] for p in r["trail"]] == \
         ["raise-prefetch", "raise-prefetch", "converged"], \

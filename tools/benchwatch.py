@@ -226,6 +226,28 @@ def main() -> int:
                 ("multichip-fleet-report",
                  [sys.executable, "tools/fleet_report.py",
                   "--out", args.out + ".fleet"], env),
+                # ISSUE 20 placed-reductions trio (BENCHMARKS.md round 20
+                # pre-registration).  bench-zipf-hier: the 2-process
+                # fleet pair on the planner's hierarchical 2-D program
+                # (keyrange on the inner pair, tree across the gloo
+                # "DCN"), fleet verdict + trace attached — fleet_report
+                # removes its own stale shards, and the kernel-smoke
+                # sweep above has already run.  The overlap/monolithic
+                # bench A/B below measures the window-boundary overlap
+                # win on the streamed ingest (both keep the streamed
+                # post-phase: it IS the measurement; both are A/B
+                # evidence, LAST_GOOD refuses the knob).  The prediction:
+                # the overlap win is bounded by the monolithic row's
+                # measured collective share — a bigger "win" is noise,
+                # a loss gets the dead-end-ledger entry.
+                ("bench-zipf-hier",
+                 [sys.executable, "tools/fleet_report.py",
+                  "--out", args.out + ".hier",
+                  "--merge-strategy", "hier-kr-tree", "--overlap"], env),
+                ("bench-zipf-overlap", [sys.executable, "bench.py"],
+                 {**env, "BENCH_MERGE_OVERLAP": "1", "BENCH_TRACE": "1"}),
+                ("bench-zipf-monolithic", [sys.executable, "bench.py"],
+                 {**env, "BENCH_TRACE": "1"}),
                 # Defaults row = stable2 since round 5 (+5.9% measured).
                 ("bench-zipf", [sys.executable, "bench.py"], env),
                 # ISSUE 5 dispatch-window A/B: streamed ingest with the

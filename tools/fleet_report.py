@@ -62,11 +62,26 @@ def _worker(pid: int, n_proc: int, port: str, corpus: str, chunk: int,
     from mapreduce_tpu.obs import Telemetry
     from mapreduce_tpu.runtime import executor
 
-    cfg = Config(chunk_bytes=chunk, table_capacity=1 << 12)
+    # Placed-reduction knobs (ISSUE 20), env-carried so the internal
+    # --worker argv stays stable: the merge strategy the global finish
+    # builds, and window-boundary overlap (partial merges ride inside
+    # the map stream; the ledger then carries op="partial" collective
+    # records and the fleet verdict charges only the visible share).
+    merge = os.environ.get("FLEET_MERGE_STRATEGY", "tree")
+    overlap = os.environ.get("FLEET_MERGE_OVERLAP") == "1"
+    cfg = Config(chunk_bytes=chunk, table_capacity=1 << 12,
+                 merge_strategy=merge, merge_overlap=overlap)
+    mesh = None
+    if merge.startswith("hier-"):
+        # The hier-* 2-D programs need the process-major two-level mesh
+        # (outer axis rides the gloo "DCN", inner the per-process pair).
+        from mapreduce_tpu.parallel.mesh import two_level_mesh
+
+        mesh = two_level_mesh(n_proc, DEV_PER_PROC, devices=jax.devices())
     tel = Telemetry.create(ledger_path=ledger, run_id="fleetreport")
     try:
         rr = executor.run_job_global(WordCountJob(cfg), corpus, config=cfg,
-                                     telemetry=tel)
+                                     mesh=mesh, telemetry=tel)
     finally:
         tel.close()
     if dist.is_coordinator():
@@ -100,6 +115,15 @@ def main() -> int:
     ap.add_argument("--mb", type=float, default=1.0)
     ap.add_argument("--chunk", type=int, default=4096)
     ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--merge-strategy", default="tree",
+                    help="collective merge strategy for the global finish "
+                         "(hier-* builds the 2-process x 2-device "
+                         "two-level mesh)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="window-boundary partial merges (ISSUE 20): "
+                         "op='partial' collective records land in the "
+                         "shards and the fleet verdict splits "
+                         "visible/hidden collective time")
     args = ap.parse_args()
     if args.worker:
         w = args.worker
@@ -131,6 +155,11 @@ def main() -> int:
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS")}
     env["PYTHONPATH"] = REPO
+    env["FLEET_MERGE_STRATEGY"] = args.merge_strategy
+    if args.overlap:
+        env["FLEET_MERGE_OVERLAP"] = "1"
+    else:
+        env.pop("FLEET_MERGE_OVERLAP", None)
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--worker",
          str(p), str(N_PROC), str(port), corpus, str(args.chunk), ledger],
@@ -180,7 +209,10 @@ def main() -> int:
         "hosts": view["hosts"],
         "aligned": view["aligned"],
         "span_s": view["span_s"],
+        "merge_strategy": args.merge_strategy,
+        "merge_overlap": bool(args.overlap),
         "fleet_bottleneck": view["fleet_bottleneck"],
+        "collective": view["collective"],
         "straggler_skew_s": view["straggler"]["total_skew_s"],
         "imbalance": view["imbalance"]["verdict"],
         "ledger": ledger,

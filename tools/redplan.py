@@ -375,11 +375,23 @@ def selftest() -> int:
     # pays double DCN traffic — the hand-priced table.
     p = mc.plan(2, 4, 8192)
     order = [r["strategy"] for r in p["ranked"]]
-    assert order == ["gather", "tree", "keyrange"], order
+    assert order == ["gather", "tree", "hier-tree-tree", "hier-kr-tree",
+                     "keyrange"], order
     by = {r["strategy"]: r["modeled_s"] for r in p["ranked"]}
     assert math.isclose(by["gather"], 0.000217042, rel_tol=1e-6), by
     assert math.isclose(by["tree"], 0.000221945, rel_tol=1e-6), by
     assert math.isclose(by["keyrange"], 0.000567002, rel_tol=1e-6), by
+    # hier-tree-tree prices identically to tree (same schedule, named
+    # placement); declaration order keeps the incumbent ahead on the tie.
+    assert by["hier-tree-tree"] == by["tree"]
+    # hier-kr-tree = keyrange over the 4-wide ICI axis (dense per-owner
+    # sub-tables) + one tree round over the 2-wide DCN axis.
+    m8k = mc.table_bytes(8192)
+    assert math.isclose(
+        by["hier-kr-tree"],
+        mc.keyrange(m8k, 4, levels["ici"], slack=slack)
+        + mc.allreduce_tree(m8k, 2, levels["dcn"]),
+        rel_tol=1e-5), by  # plan() rounds modeled_s to 9 digits
     assert p["mesh"]["label"] == "2dx4i" and p["payload_bytes"] == 229376
 
     # At 4x the capacity the tree's log2(D) rounds beat gather's (D-1)
@@ -389,7 +401,8 @@ def selftest() -> int:
     p = mc.plan(2, 4, 32768, top_mass=0.3, table_occupancy=0.85,
                 incumbent="tree")
     order = [r["strategy"] for r in p["ranked"]]
-    assert order == ["tree", "gather", "keyrange"], order
+    assert order == ["tree", "hier-tree-tree", "gather", "hier-kr-tree",
+                     "keyrange"], order
     by = {r["strategy"]: r for r in p["ranked"]}
     assert math.isclose(by["tree"]["modeled_s"], 0.00052778,
                         rel_tol=1e-6), by["tree"]
@@ -398,15 +411,24 @@ def selftest() -> int:
     base = mc.keyrange(mc.table_bytes(32768), 8, levels["dcn"], slack=slack)
     assert math.isclose(kr["modeled_s"], base * 1.3, rel_tol=1e-6), kr
     assert any("skew derating" in n for n in kr["notes"]), kr["notes"]
-    # No keyrange hook -> the strategy is skipped, never silently priced.
+    # No keyrange hook -> the strategy is skipped, never silently priced
+    # (hier-kr-tree's inner leg is the same hook).
     p8 = mc.plan(8, 1, 8192, has_keyrange_hook=False)
-    assert [s["strategy"] for s in p8["skipped"]] == ["keyrange"]
+    assert [s["strategy"] for s in p8["skipped"]] \
+        == ["keyrange", "hier-kr-tree"]
     assert all(r["strategy"] != "keyrange" for r in p8["ranked"])
+    # A single-host mesh has one link level: nothing to place over, so
+    # both hierarchical compositions are skipped with the mesh reason.
+    p1 = mc.plan(1, 8, 8192)
+    assert [s["strategy"] for s in p1["skipped"]] \
+        == ["hier-kr-tree", "hier-tree-tree"]
 
     # Strategy descriptors name the exact runtime builders (the pytest
     # suite asserts the full bijection against parallel/collectives.py;
     # here just the jax-free half).
-    assert set(mc.STRATEGIES) == {"tree", "gather", "keyrange"}
+    assert set(mc.STRATEGIES) == {"tree", "gather", "keyrange",
+                                  "hier-kr-tree", "hier-tree-tree"}
+    assert mc.STRATEGIES["hier-kr-tree"].needs_keyrange_hook
     assert mc.STRATEGIES["tree"].builder.endswith("collectives.tree_merge")
     assert mc.STRATEGIES["tree"].power_of_two_only
     assert mc.STRATEGIES["keyrange"].needs_keyrange_hook
